@@ -1,0 +1,28 @@
+"""ddp_classification_pytorch_tpu — a TPU-native (JAX/XLA/pjit) classification
+training framework with the capabilities of XiaoyuWant/DDP_Classification_pytorch.
+
+The reference is five independent CUDA/DDP training silos (BASELINE, ARCFACE,
+CDR, NESTED, PLC — see SURVEY.md). This package re-designs the same capability
+set TPU-first:
+
+- one shared package instead of five silos;
+- `jax.jit` + `jax.sharding.NamedSharding` over a device `Mesh` instead of
+  `torch.distributed.launch` + NCCL DDP (reference BASELINE/main.py:35-38,147-149);
+- cross-replica BatchNorm comes for free from global-batch sharding under jit
+  (the reference needs SyncBatchNorm, BASELINE/main.py:148);
+- algorithms (ArcFace margin head, CDR selective gradients, Nested Dropout,
+  PLC label correction) are pure functional transforms that compose with optax;
+- tests run the real sharded code path on a virtual 8-device CPU mesh.
+
+Layout:
+    config.py   dataclass config tree (reference: argparse per silo)
+    data/       datasets, transforms, per-host sharded loader
+    models/     Flax ResNet/VGG zoos, feature/classifier split, heads
+    ops/        algorithm cores: ArcFace math, CDR transform, nested masks,
+                label-noise toolkit, pallas kernels
+    parallel/   mesh construction, sharding rules, collectives helpers
+    train/      unified train/eval loop, schedules, checkpointing, logging
+    cli/        per-workload entry points mirroring the reference launch scripts
+"""
+
+__version__ = "0.1.0"

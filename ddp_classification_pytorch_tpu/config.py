@@ -1,0 +1,204 @@
+"""Config tree for all workloads.
+
+The reference scatters configuration across four argparse blocks and hardcoded
+constants (BASELINE/main.py:25-32,84-87; ARCFACE/arc_main.py:34-43;
+CDR/main.py:32-57; NESTED/train.py:458-486). Here every knob is a typed field
+on one dataclass tree, with per-workload presets that reproduce the reference
+defaults exactly.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from dataclasses import dataclass, field
+from typing import Any, Sequence
+
+
+@dataclass
+class DataConfig:
+    """Dataset + input-pipeline options.
+
+    Reference semantics carried over: per-class image caps (500 for BASELINE
+    BASELINE/main.py:98,107; 400 for ARCFACE arc_main.py:190; CDR additionally
+    keeps only the first 100 class dirs, CDR/main.py:73-81), ImageNet
+    normalization constants, and epoch-seeded reshuffle equal to
+    `DistributedSampler.set_epoch` (BASELINE/main.py:269).
+    """
+
+    train_dir: str = ""
+    val_dir: str = ""
+    dataset: str = "imagefolder"  # imagefolder | synthetic | plc
+    image_size: int = 224
+    train_crop_size: int = 256  # reference RandomResizedCrop(256), BASELINE/main.py:61
+    num_classes: int = 2173  # BASELINE/main.py:85
+    imgs_per_class: int = 500  # BASELINE/main.py:98
+    max_classes: int = 0  # 0 = all; CDR uses 100 (CDR/main.py:73)
+    batch_size: int = 16  # per-process global batch is batch_size * num_hosts
+    num_workers: int = 4  # BASELINE/main.py:130-131
+    prefetch: int = 2
+    synthetic_size: int = 0  # for dataset == "synthetic"
+    # transform preset: baseline | cdr | cifar | clothing1m (SURVEY C15)
+    transform: str = "baseline"
+
+
+@dataclass
+class ModelConfig:
+    """Backbone + head selection.
+
+    arch covers the reference zoo: torchvision-style ImageNet ResNets
+    (NESTED/model/imagenet_resnet.py), CIFAR ResNets
+    (NESTED/model/cifar_resnet.py), VGG19-BN (NESTED/model/vgg.py).
+    """
+
+    arch: str = "resnet50"
+    variant: str = "imagenet"  # imagenet | cifar
+    pretrained: bool = False  # torchvision-weight import hook (round 2+)
+    feat_dim: int = 0  # 0 = arch default (512 r18/34, 2048 r50+)
+    head: str = "fc"  # fc | arcface | nested
+    # ArcFace (ARCFACE/arc_main.py:234: s=30, m=0.5, easy_margin=True)
+    arc_s: float = 30.0
+    arc_m: float = 0.5
+    arc_easy_margin: bool = True
+    arc_embed_dim: int = 256  # arc_main.py:223-231: 2048->512->256 embedding
+    # Nested dropout (NESTED/train.py:512-530: nested=100 i.e. sigma of the
+    # Gaussian over feature dims; freeze_bn=True)
+    nested_std: float = 100.0
+    freeze_bn: bool = False
+    dropout: float = 0.0
+    dtype: str = "bfloat16"  # compute dtype; params and BN stats stay f32
+
+
+@dataclass
+class OptimConfig:
+    """Optimizer + LR schedule.
+
+    Reference recipes: SGD(momentum=0.9) lr 1e-3 + StepLR(10, 0.1)
+    (BASELINE/main.py:86,153-154); Adam-or-SGD switch (arc_main.py:248-253);
+    MultiStepLR([10,20]) (CDR/main.py:340) / ([20,30,40,120])
+    (NESTED/train.py:472); linear iteration warmup (BASELINE/main.py:170-197,
+    NESTED/train.py:276-327).
+    """
+
+    optimizer: str = "sgd"  # sgd | adam
+    lr: float = 1e-3
+    momentum: float = 0.9
+    weight_decay: float = 0.0
+    schedule: str = "step"  # step | multistep | constant
+    step_size: int = 10
+    gamma: float = 0.1
+    milestones: Sequence[int] = field(default_factory=lambda: (10, 20))
+    warmup_iters: int = 0
+    warmup_start_lr: float = 1e-6  # BASELINE/main.py:175
+    grad_transform: str = "none"  # none | cdr
+    # CDR (CDR/main.py:37,54): keep top (1-noise_rate) of grad mass
+    noise_rate: float = 0.2
+    num_gradual: int = 10
+    # Reference quirk (CDR/main.py:222-227): the gradual clip schedule is dead
+    # code, overwritten with the constant. True reproduces the reference.
+    cdr_dead_schedule: bool = True
+
+
+@dataclass
+class ParallelConfig:
+    """Mesh layout. The reference supports DP only (SURVEY §2.2); we add a
+    `model` axis so wide class-dim heads (ArcFace, 2173→10⁶ identities) can be
+    tensor-sharded — the vision analogue of sequence parallelism."""
+
+    data_axis: int = 0  # 0 = all devices on data axis
+    model_axis: int = 1
+    # microbatching / grad accumulation (capability headroom; reference: none)
+    grad_accum: int = 1
+
+
+@dataclass
+class RunConfig:
+    """Loop + IO. Epochs/ckpt/record semantics per BASELINE/main.py:258-317."""
+
+    epochs: int = 100  # NUM_EPOCH, BASELINE/main.py:87
+    seed: int = 999  # set_seed(999), BASELINE/main.py:43-50
+    log_every: int = 20  # BASELINE/main.py:284
+    eval_every: int = 1
+    out_dir: str = "./runs/default"
+    save_every_epoch: bool = True  # BASELINE/main.py:308-310
+    save_best_only: bool = False  # NESTED netBest.pth policy, train.py:154-161
+    resume: str = ""  # NESTED --resumePth, train.py:372-378
+    write_records: bool = True  # output.txt / history.json (SURVEY C23)
+
+
+@dataclass
+class Config:
+    workload: str = "baseline"  # baseline | arcface | cdr | nested | plc
+    data: DataConfig = field(default_factory=DataConfig)
+    model: ModelConfig = field(default_factory=ModelConfig)
+    optim: OptimConfig = field(default_factory=OptimConfig)
+    parallel: ParallelConfig = field(default_factory=ParallelConfig)
+    run: RunConfig = field(default_factory=RunConfig)
+
+    def replace(self, **kw: Any) -> "Config":
+        return dataclasses.replace(self, **kw)
+
+
+def baseline_preset() -> Config:
+    """BASELINE/main.py defaults: ResNet-50, CE, batch 16/proc, SGD 1e-3,
+    StepLR(10,0.1), 100 epochs, 2173 classes, ≤500 imgs/class."""
+    return Config(workload="baseline")
+
+
+def arcface_preset() -> Config:
+    """ARCFACE/arc_main.py: ResNet-50 → 256-d embedding + ArcMarginProduct
+    (s=30, m=0.5, easy_margin=True at :234), batch 32, Adam 1e-3."""
+    cfg = Config(workload="arcface")
+    cfg.data.batch_size = 32
+    cfg.data.imgs_per_class = 400  # arc_main.py:190
+    cfg.model.head = "arcface"
+    cfg.optim.optimizer = "adam"
+    return cfg
+
+
+def cdr_preset() -> Config:
+    """CDR/main.py: ResNet-50, batch 128, SGD 0.1, MultiStepLR([10,20]),
+    selective-gradient step, first 100 classes."""
+    cfg = Config(workload="cdr")
+    cfg.data.batch_size = 128
+    cfg.data.max_classes = 100
+    cfg.data.num_classes = 100
+    cfg.data.transform = "cdr"
+    cfg.optim.lr = 0.1
+    cfg.optim.schedule = "multistep"
+    cfg.optim.milestones = (10, 20)
+    cfg.optim.grad_transform = "cdr"
+    cfg.run.epochs = 30  # CDR/main.py:54 n_epoch default
+    return cfg
+
+
+def nested_preset() -> Config:
+    """NESTED/train.py: ResNet-50 feat + bias-free linear cls, batch 128,
+    10k-iter warmup → lr 1e-2, MultiStepLR([20,30,40,120]), nested σ=100,
+    freeze-BN (main() hardcodes nested=100, freeze_bn=True at :527,529)."""
+    cfg = Config(workload="nested")
+    cfg.data.batch_size = 128
+    cfg.model.head = "nested"
+    cfg.model.nested_std = 100.0
+    cfg.model.freeze_bn = True
+    cfg.optim.lr = 1e-2
+    cfg.optim.schedule = "multistep"
+    cfg.optim.milestones = (20, 30, 40, 120)
+    cfg.optim.warmup_iters = 10000
+    cfg.run.epochs = 50
+    cfg.run.save_best_only = True
+    return cfg
+
+
+PRESETS = {
+    "baseline": baseline_preset,
+    "arcface": arcface_preset,
+    "cdr": cdr_preset,
+    "nested": nested_preset,
+}
+
+
+def get_preset(name: str) -> Config:
+    try:
+        return PRESETS[name]()
+    except KeyError:
+        raise ValueError(f"unknown workload {name!r}; one of {sorted(PRESETS)}")

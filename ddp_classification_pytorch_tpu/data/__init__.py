@@ -1,0 +1,11 @@
+from .imagefolder import ImageFolderDataset, scan_image_folder
+from .synthetic import SyntheticDataset
+from .transforms import TRANSFORM_PRESETS, build_transform
+from .loader import ShardedLoader, shard_indices_for_host
+from .plc import PLCDataset
+
+__all__ = [
+    "ImageFolderDataset", "scan_image_folder", "SyntheticDataset",
+    "TRANSFORM_PRESETS", "build_transform", "ShardedLoader",
+    "shard_indices_for_host", "PLCDataset",
+]

@@ -1,0 +1,84 @@
+"""ImageFolder dataset with the reference's per-class caps.
+
+Parity with `ImageFolderMy` (BASELINE/main.py:97-121, ARCFACE/arc_main.py:178-204,
+CDR/main.py:69-94): glob class directories under `root`, label = sorted class
+index, cap images per class (500 baseline / 400 arcface), and optionally keep
+only the first `max_classes` class dirs (CDR keeps 100, CDR/main.py:73-81).
+
+Unlike the reference (which globs lazily per rank), the scan happens once and
+deterministically (sorted order) so every host in a multi-host job derives an
+identical index space — the precondition for correct per-host sharding.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import glob
+import os
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+from PIL import Image
+
+from .transforms import Transform
+
+_EXTS = (".jpg", ".jpeg", ".png", ".bmp", ".webp")
+
+
+def scan_image_folder(
+    root: str,
+    imgs_per_class: int = 0,
+    max_classes: int = 0,
+) -> Tuple[List[str], List[int], List[str]]:
+    """→ (paths, labels, class_names). Caps mirror the reference exactly:
+    glob order within a class, cap after glob (BASELINE/main.py:105-113)."""
+    class_dirs = sorted(d for d in glob.glob(os.path.join(root, "*")) if os.path.isdir(d))
+    if max_classes:
+        class_dirs = class_dirs[:max_classes]
+    paths: List[str] = []
+    labels: List[int] = []
+    names: List[str] = []
+    for idx, cdir in enumerate(class_dirs):
+        names.append(os.path.basename(cdir))
+        files = sorted(
+            f for f in glob.glob(os.path.join(cdir, "*"))
+            if f.lower().endswith(_EXTS)
+        )
+        if imgs_per_class:
+            files = files[:imgs_per_class]
+        paths.extend(files)
+        labels.extend([idx] * len(files))
+    return paths, labels, names
+
+
+@dataclasses.dataclass
+class ImageFolderDataset:
+    """Indexable dataset: __getitem__(i, rng) → (float32 HWC image, label)."""
+
+    paths: Sequence[str]
+    labels: Sequence[int]
+    class_names: Sequence[str]
+    transform: Transform
+
+    @classmethod
+    def from_root(
+        cls, root: str, transform: Transform,
+        imgs_per_class: int = 0, max_classes: int = 0,
+    ) -> "ImageFolderDataset":
+        paths, labels, names = scan_image_folder(root, imgs_per_class, max_classes)
+        if not paths:
+            raise FileNotFoundError(f"no class dirs with images under {root!r}")
+        return cls(paths, np.asarray(labels, np.int32), names, transform)
+
+    def __len__(self) -> int:
+        return len(self.paths)
+
+    @property
+    def num_classes(self) -> int:
+        return len(self.class_names)
+
+    def __getitem__(self, i: int, rng: Optional[np.random.Generator] = None):
+        rng = rng or np.random.default_rng()
+        with Image.open(self.paths[i]) as img:
+            arr = self.transform(img, rng)
+        return arr, int(self.labels[i])

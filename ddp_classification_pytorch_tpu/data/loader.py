@@ -1,0 +1,161 @@
+"""Per-host sharded, prefetching data loader.
+
+This is the corrected, TPU-native replacement for the reference's
+`DistributedSampler` + `DataLoader(num_workers=4, pin_memory=True)` stack
+(BASELINE/main.py:127-131):
+
+- **Global identity done right.** The reference passes *local* rank as global
+  rank (`DistributedSampler(rank=args.local_rank)`, BASELINE/main.py:127 — a
+  multi-node correctness bug, SURVEY §2.2). Here each host slices the epoch
+  permutation by `jax.process_index()/process_count()`.
+- **`set_epoch` semantics.** Epoch-seeded permutation identical across hosts
+  (BASELINE/main.py:269) — all hosts derive the same permutation and take
+  disjoint contiguous slices; padding wraps indices like DistributedSampler.
+- **Worker parallelism** via a thread pool (PIL/numpy release the GIL in the
+  hot paths) + a bounded background prefetch queue — the host-side analogue of
+  `num_workers` + `pin_memory`.
+
+The loader yields host-local numpy batches; `parallel/mesh.py:make_global_array`
+assembles them into a globally-sharded `jax.Array` over the `data` axis (the
+device side of the old H2D `pin_memory` overlap).
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from concurrent.futures import ThreadPoolExecutor
+from typing import Iterator, Optional, Tuple
+
+import numpy as np
+
+
+def shard_indices_for_host(
+    n: int,
+    epoch: int,
+    seed: int,
+    batch_size: int,
+    shuffle: bool = True,
+    host_id: Optional[int] = None,
+    num_hosts: Optional[int] = None,
+    drop_last: bool = False,
+) -> np.ndarray:
+    """Deterministic per-host index shard for one epoch.
+
+    All hosts compute the same permutation (seed ⊕ epoch), pad it by wrapping
+    to a multiple of num_hosts·batch_size (DistributedSampler's pad-by-repeat),
+    and take the host's contiguous slice.
+    """
+    import jax
+
+    host_id = jax.process_index() if host_id is None else host_id
+    num_hosts = jax.process_count() if num_hosts is None else num_hosts
+
+    idx = np.arange(n, dtype=np.int64)
+    if shuffle:
+        rng = np.random.default_rng(np.uint32(seed) ^ np.uint32(epoch * 0x9E3779B9))
+        rng.shuffle(idx)
+    chunk = num_hosts * batch_size
+    if drop_last:
+        idx = idx[: (n // chunk) * chunk]
+    elif n % chunk:
+        pad = chunk - n % chunk
+        idx = np.concatenate([idx, idx[:pad]])
+    per_host = len(idx) // num_hosts
+    return idx[host_id * per_host : (host_id + 1) * per_host]
+
+
+class ShardedLoader:
+    """Iterates (images, labels) numpy batches for this host.
+
+    dataset must support `__len__` and `__getitem__(i, rng)` →
+    (HWC float32, int label).
+    """
+
+    def __init__(
+        self,
+        dataset,
+        batch_size: int,
+        shuffle: bool = True,
+        seed: int = 999,
+        num_workers: int = 4,
+        prefetch: int = 2,
+        drop_last: bool = False,
+        host_id: Optional[int] = None,
+        num_hosts: Optional[int] = None,
+    ):
+        self.dataset = dataset
+        self.batch_size = batch_size
+        self.shuffle = shuffle
+        self.seed = seed
+        self.num_workers = max(num_workers, 1)
+        self.prefetch = max(prefetch, 1)
+        self.drop_last = drop_last
+        self.host_id = host_id
+        self.num_hosts = num_hosts
+        self.epoch = 0
+
+    def set_epoch(self, epoch: int) -> None:
+        """Reshuffle hook (reference sampler.set_epoch, BASELINE/main.py:269)."""
+        self.epoch = epoch
+
+    def _epoch_indices(self) -> np.ndarray:
+        return shard_indices_for_host(
+            len(self.dataset), self.epoch, self.seed, self.batch_size,
+            self.shuffle, self.host_id, self.num_hosts, self.drop_last,
+        )
+
+    def __len__(self) -> int:
+        return len(self._epoch_indices()) // self.batch_size
+
+    def _load_batch(self, batch_idx: int, indices: np.ndarray) -> Tuple[np.ndarray, np.ndarray]:
+        def load(j_and_i):
+            j, i = j_and_i
+            rng = np.random.default_rng(
+                (self.seed, self.epoch, int(i), j)
+            )
+            return self.dataset.__getitem__(int(i), rng)
+
+        if self.num_workers > 1:
+            with ThreadPoolExecutor(self.num_workers) as ex:
+                items = list(ex.map(load, enumerate(indices)))
+        else:
+            items = [load(ji) for ji in enumerate(indices)]
+        images = np.stack([im for im, _ in items])
+        labels = np.asarray([lb for _, lb in items], np.int32)
+        return images, labels
+
+    def __iter__(self) -> Iterator[Tuple[np.ndarray, np.ndarray]]:
+        indices = self._epoch_indices()
+        n_batches = len(indices) // self.batch_size
+        if n_batches == 0:
+            return
+        q: "queue.Queue" = queue.Queue(maxsize=self.prefetch)
+        stop = threading.Event()
+
+        def producer():
+            try:
+                for b in range(n_batches):
+                    if stop.is_set():
+                        return
+                    sl = indices[b * self.batch_size : (b + 1) * self.batch_size]
+                    q.put(self._load_batch(b, sl))
+            finally:
+                q.put(None)
+
+        t = threading.Thread(target=producer, daemon=True)
+        t.start()
+        try:
+            while True:
+                item = q.get()
+                if item is None:
+                    break
+                yield item
+        finally:
+            stop.set()
+            # drain so the producer can exit
+            while t.is_alive():
+                try:
+                    q.get_nowait()
+                except queue.Empty:
+                    break

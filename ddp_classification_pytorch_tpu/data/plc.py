@@ -1,0 +1,145 @@
+"""PLC (Clothing1M-style) annotation-file dataset + label tooling.
+
+Parity with `PLC/FolderDataset.py`:
+- `FolderDataset` (:9-82): key-list + label files per split
+  (`annotations/{split}_key_list.txt`, `noisy_label_kv.txt`,
+  `clean_label_kv.txt`), optional per-class subsample of `cls_size` via a
+  seeded permutation (:43-50), __getitem__ returns (image, label, index)
+  (:56-75) so correction loops can address samples, and in-place label
+  mutation `update_corrupted_label` (:80-82).
+- annotation builders (`get_train_labels`:85-110 etc.) generalized: instead
+  of hardcoded absolute paths, `build_annotations` derives key lists from a
+  folder tree.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+from PIL import Image
+
+from .transforms import Transform
+
+
+def _read_kv(path: str) -> Dict[str, int]:
+    out: Dict[str, int] = {}
+    with open(path) as f:
+        for line in f:
+            parts = line.split()
+            if len(parts) >= 2:
+                out[parts[0]] = int(parts[1])
+    return out
+
+
+def _read_list(path: str) -> List[str]:
+    with open(path) as f:
+        return [ln.strip() for ln in f if ln.strip()]
+
+
+@dataclasses.dataclass
+class PLCDataset:
+    """Split dataset over an annotation dir (PLC/FolderDataset.py:9-54)."""
+
+    data_root: str
+    keys: List[str]
+    labels: np.ndarray  # mutable — label-correction target
+    clean_labels: Optional[np.ndarray]
+    transform: Transform
+
+    @classmethod
+    def from_annotations(
+        cls,
+        data_root: str,
+        split: str,
+        transform: Transform,
+        cls_size: int = 0,
+        num_classes: int = 14,
+        seed: int = 123,
+    ) -> "PLCDataset":
+        ann = os.path.join(data_root, "annotations")
+        keys = _read_list(os.path.join(ann, f"{split}_key_list.txt"))
+        noisy = _read_kv(os.path.join(ann, "noisy_label_kv.txt"))
+        clean_path = os.path.join(ann, "clean_label_kv.txt")
+        clean = _read_kv(clean_path) if os.path.exists(clean_path) else {}
+
+        # train labels come from the noisy file; val/test prefer clean
+        # (FolderDataset.py:20-38)
+        src = noisy if split == "train" else (clean or noisy)
+        keys = [k for k in keys if k in src]
+        labels = np.asarray([src[k] for k in keys], np.int64)
+
+        if cls_size and split == "train":
+            # per-class subsample with np.random.permutation (:43-50)
+            rng = np.random.RandomState(seed)
+            keep: List[int] = []
+            for c in range(num_classes):
+                idx = np.nonzero(labels == c)[0]
+                idx = rng.permutation(idx)[:cls_size]
+                keep.extend(idx.tolist())
+            keep_arr = np.asarray(sorted(keep), np.int64)
+            keys = [keys[i] for i in keep_arr]
+            labels = labels[keep_arr]
+
+        clean_arr = (
+            np.asarray([clean.get(k, -1) for k in keys], np.int64) if clean else None
+        )
+        return cls(data_root, keys, labels.copy(), clean_arr, transform)
+
+    def __len__(self) -> int:
+        return len(self.keys)
+
+    def __getitem__(self, i: int, rng: Optional[np.random.Generator] = None):
+        """→ (image, label, index) — index lets correction loops address
+        samples (FolderDataset.py:56-75)."""
+        rng = rng or np.random.default_rng()
+        with Image.open(os.path.join(self.data_root, self.keys[i])) as img:
+            arr = self.transform(img, rng)
+        return arr, int(self.labels[i]), i
+
+    def update_corrupted_label(self, new_labels: Sequence[int]) -> None:
+        """In-place label replacement for correction loops
+        (FolderDataset.py:80-82)."""
+        new = np.asarray(new_labels, np.int64)
+        if new.shape != self.labels.shape:
+            raise ValueError(f"label shape {new.shape} != {self.labels.shape}")
+        self.labels[:] = new
+
+
+def build_annotations(
+    image_root: str,
+    out_dir: str,
+    splits: Tuple[str, ...] = ("train", "val", "test"),
+    val_frac: float = 0.1,
+    test_frac: float = 0.1,
+    seed: int = 0,
+) -> None:
+    """Generalized annotation builder (replaces the hardcoded-path one-offs at
+    PLC/FolderDataset.py:85-152): scans `image_root/<class>/<img>` and writes
+    key lists + a noisy_label_kv.txt (labels = folder index)."""
+    from .imagefolder import scan_image_folder
+
+    paths, labels, _ = scan_image_folder(image_root)
+    keys = [os.path.relpath(p, image_root) for p in paths]
+    rng = np.random.default_rng(seed)
+    order = rng.permutation(len(keys))
+    n_val = int(len(keys) * val_frac)
+    n_test = int(len(keys) * test_frac)
+    split_idx = {
+        "val": order[:n_val],
+        "test": order[n_val : n_val + n_test],
+        "train": order[n_val + n_test :],
+    }
+    os.makedirs(out_dir, exist_ok=True)
+    with open(os.path.join(out_dir, "noisy_label_kv.txt"), "w") as f:
+        for k, lb in zip(keys, labels):
+            f.write(f"{k} {lb}\n")
+    with open(os.path.join(out_dir, "clean_label_kv.txt"), "w") as f:
+        for k, lb in zip(keys, labels):
+            f.write(f"{k} {lb}\n")
+    for split in splits:
+        with open(os.path.join(out_dir, f"{split}_key_list.txt"), "w") as f:
+            for i in split_idx.get(split, []):
+                f.write(keys[int(i)] + "\n")

@@ -1,0 +1,47 @@
+"""Synthetic dataset for tests and benchmarks — deterministic, no filesystem.
+
+The reference has no equivalent (it always trains from real folders); this is
+framework infrastructure for the test/bench strategy (SURVEY §4): shapes match
+the real pipeline so the jitted train step is identical.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Tuple
+
+import numpy as np
+
+
+@dataclasses.dataclass
+class SyntheticDataset:
+    size: int
+    image_size: int = 32
+    num_classes: int = 10
+    seed: int = 0
+    channels: int = 3
+
+    def __post_init__(self) -> None:
+        rng = np.random.default_rng(self.seed)
+        self.labels = rng.integers(0, self.num_classes, size=self.size).astype(np.int32)
+        # per-class mean images make the task learnable (loss must drop in e2e tests)
+        self.class_means = rng.normal(0, 1, size=(self.num_classes, 1, 1, self.channels)).astype(np.float32)
+
+    def __len__(self) -> int:
+        return self.size
+
+    @property
+    def class_names(self):
+        return [str(i) for i in range(self.num_classes)]
+
+    @property
+    def num_classes_(self) -> int:
+        return self.num_classes
+
+    def __getitem__(self, i: int, rng: Optional[np.random.Generator] = None) -> Tuple[np.ndarray, int]:
+        label = int(self.labels[i])
+        item_rng = np.random.default_rng(self.seed * 1_000_003 + i)
+        img = self.class_means[label] + 0.1 * item_rng.normal(
+            size=(self.image_size, self.image_size, self.channels)
+        ).astype(np.float32)
+        return img.astype(np.float32), label

@@ -1,0 +1,128 @@
+"""Host-side image transforms — numpy/PIL implementations of the reference's
+torchvision pipelines (SURVEY C15).
+
+Presets:
+- baseline train: RandomResizedCrop(256, scale 0.8-1.0) + flip + normalize
+  (BASELINE/main.py:58-68); val: Resize(256)+CenterCrop(224)
+  (BASELINE/main.py:69-76, ARCFACE identical).
+- cdr train: adds RandomRotation(degrees≈15) + flip + CenterCrop
+  (CDR/main.py:112-121).
+- cifar train: RandomCrop(32, padding=4) + flip (NESTED/train.py:40-44).
+- clothing1m train: RandomResizedCrop(224) + flip (NESTED/train.py:55-59).
+
+All emit float32 NHWC normalized with the ImageNet mean/std the reference
+hardcodes everywhere. TPU note: outputs are channel-last (NHWC), XLA:TPU's
+native conv layout; the reference's NCHW is a torch convention, not copied.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable, Tuple
+
+import numpy as np
+from PIL import Image
+
+IMAGENET_MEAN = np.array([0.485, 0.456, 0.406], np.float32)
+IMAGENET_STD = np.array([0.229, 0.224, 0.225], np.float32)
+
+
+def normalize(img: np.ndarray) -> np.ndarray:
+    """uint8 HWC → float32 HWC normalized."""
+    return (img.astype(np.float32) / 255.0 - IMAGENET_MEAN) / IMAGENET_STD
+
+
+def random_resized_crop(
+    img: Image.Image, rng: np.random.Generator, size: int,
+    scale: Tuple[float, float] = (0.08, 1.0), ratio: Tuple[float, float] = (3 / 4, 4 / 3),
+) -> Image.Image:
+    """torchvision RandomResizedCrop semantics (area-scale + log-ratio sample,
+    10 tries then center-crop fallback)."""
+    w, h = img.size
+    area = w * h
+    for _ in range(10):
+        target_area = area * rng.uniform(*scale)
+        log_ratio = (np.log(ratio[0]), np.log(ratio[1]))
+        aspect = np.exp(rng.uniform(*log_ratio))
+        cw = int(round(np.sqrt(target_area * aspect)))
+        ch = int(round(np.sqrt(target_area / aspect)))
+        if 0 < cw <= w and 0 < ch <= h:
+            x = int(rng.integers(0, w - cw + 1))
+            y = int(rng.integers(0, h - ch + 1))
+            return img.resize((size, size), Image.BILINEAR, box=(x, y, x + cw, y + ch))
+    # fallback: center crop to the in-range aspect
+    side = min(w, h)
+    x, y = (w - side) // 2, (h - side) // 2
+    return img.resize((size, size), Image.BILINEAR, box=(x, y, x + side, y + side))
+
+
+def resize_center_crop(img: Image.Image, resize: int, crop: int) -> Image.Image:
+    w, h = img.size
+    if w < h:
+        nw, nh = resize, int(h * resize / w)
+    else:
+        nw, nh = int(w * resize / h), resize
+    img = img.resize((nw, nh), Image.BILINEAR)
+    x, y = (nw - crop) // 2, (nh - crop) // 2
+    return img.crop((x, y, x + crop, y + crop))
+
+
+def random_crop_padded(img: np.ndarray, rng: np.random.Generator, size: int, pad: int) -> np.ndarray:
+    """CIFAR RandomCrop(size, padding=pad) on a HWC uint8 array."""
+    padded = np.pad(img, ((pad, pad), (pad, pad), (0, 0)), mode="constant")
+    y = int(rng.integers(0, 2 * pad + 1))
+    x = int(rng.integers(0, 2 * pad + 1))
+    return padded[y : y + size, x : x + size]
+
+
+@dataclasses.dataclass
+class Transform:
+    """A picklable (fn ships to worker processes) train/eval transform."""
+
+    kind: str
+    train: bool
+    crop_size: int
+    out_size: int
+
+    def __call__(self, img: Image.Image, rng: np.random.Generator) -> np.ndarray:
+        if img.mode != "RGB":
+            img = img.convert("RGB")
+        if self.kind == "cifar":
+            arr = np.asarray(img, np.uint8)
+            if self.train:
+                arr = random_crop_padded(arr, rng, self.out_size, 4)
+                if rng.uniform() < 0.5:
+                    arr = arr[:, ::-1]
+            return normalize(np.ascontiguousarray(arr))
+        if self.train:
+            if self.kind == "cdr":
+                # CDR/main.py:113-119: rotation ±15°, flip, resize 256, center 224
+                img = img.rotate(float(rng.uniform(-15, 15)), Image.BILINEAR)
+                img = resize_center_crop(img, self.crop_size, self.out_size)
+            elif self.kind == "clothing1m":
+                img = random_resized_crop(img, rng, self.out_size, scale=(0.08, 1.0))
+            else:  # baseline (BASELINE/main.py:60-63): RRC(crop) scale .8-1
+                img = random_resized_crop(img, rng, self.out_size, scale=(0.8, 1.0))
+            arr = np.asarray(img, np.uint8)
+            if rng.uniform() < 0.5:
+                arr = arr[:, ::-1]
+        else:
+            img = resize_center_crop(img, self.crop_size, self.out_size)
+            arr = np.asarray(img, np.uint8)
+        return normalize(np.ascontiguousarray(arr))
+
+
+TRANSFORM_PRESETS = ("baseline", "cdr", "cifar", "clothing1m")
+
+
+def build_transform(preset: str, train: bool, image_size: int = 224,
+                    crop_size: int = 256) -> Transform:
+    if preset not in TRANSFORM_PRESETS:
+        raise ValueError(f"unknown transform preset {preset!r}")
+    if preset == "cifar":
+        return Transform(preset, train, crop_size=image_size, out_size=image_size)
+    # NOTE the reference trains at RandomResizedCrop(256) but evals at
+    # CenterCrop(224) (BASELINE/main.py:61,73-74) — an asymmetric quirk we
+    # reproduce: train output size = crop_size for baseline, image_size others.
+    out = crop_size if (train and preset == "baseline") else image_size
+    return Transform(preset, train, crop_size=crop_size, out_size=out)

@@ -1,0 +1,11 @@
+from .resnet import ResNet, resnet18, resnet34, resnet50, resnet101, resnet152, FEAT_DIMS
+from .vgg import VGG, vgg19_bn
+from .heads import FCHead, ArcEmbedding, ArcMarginHead, NetClassifier
+from .factory import build_backbone, build_model
+
+__all__ = [
+    "ResNet", "resnet18", "resnet34", "resnet50", "resnet101", "resnet152",
+    "FEAT_DIMS", "VGG", "vgg19_bn",
+    "FCHead", "ArcEmbedding", "ArcMarginHead", "NetClassifier",
+    "build_backbone", "build_model",
+]

@@ -1,0 +1,109 @@
+"""Backbone/model construction from ModelConfig — replaces the per-silo model
+build blocks (BASELINE/main.py:134-144, ARCFACE/arc_main.py:223-234,
+CDR/main.py:330-338, NESTED/train.py:345-349)."""
+
+from __future__ import annotations
+
+from typing import Any, Optional, Tuple
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from ..config import ModelConfig
+from . import resnet as _resnet
+from .vgg import vgg19_bn
+from .heads import ArcEmbedding, ArcMarginHead, NetClassifier
+
+_RESNETS = {
+    "resnet18": _resnet.resnet18,
+    "resnet34": _resnet.resnet34,
+    "resnet50": _resnet.resnet50,
+    "resnet101": _resnet.resnet101,
+    "resnet152": _resnet.resnet152,
+}
+
+
+def feat_dim_for(cfg: ModelConfig) -> int:
+    if cfg.feat_dim:
+        return cfg.feat_dim
+    if cfg.arch in _resnet.FEAT_DIMS:
+        return _resnet.FEAT_DIMS[cfg.arch]
+    if cfg.arch == "vgg19_bn":
+        return 4096
+    raise ValueError(f"unknown arch {cfg.arch}")
+
+
+def build_backbone(cfg: ModelConfig, num_classes: int = 0,
+                   axis_name: Optional[str] = None) -> nn.Module:
+    """Backbone emitting features (num_classes=0) or logits."""
+    dtype = jnp.dtype(cfg.dtype)
+    if cfg.arch in _RESNETS:
+        return _RESNETS[cfg.arch](
+            num_classes=num_classes, variant=cfg.variant, dtype=dtype,
+            axis_name=axis_name, freeze_bn=cfg.freeze_bn,
+        )
+    if cfg.arch == "vgg19_bn":
+        return vgg19_bn(num_classes=num_classes, dtype=dtype,
+                        axis_name=axis_name, dropout=cfg.dropout or 0.5)
+    raise ValueError(f"unknown arch {cfg.arch!r}")
+
+
+class ClassifierModel(nn.Module):
+    """backbone → logits (BASELINE/CDR shape)."""
+
+    backbone: nn.Module
+
+    def __call__(self, x: jnp.ndarray, train: bool = True) -> jnp.ndarray:
+        return self.backbone(x, train=train)
+
+
+class ArcFaceModel(nn.Module):
+    """backbone → embedding → margin head (ARCFACE shape). Call with labels
+    for training logits; labels=None gives s·cosθ scores."""
+
+    backbone: nn.Module
+    embedding: ArcEmbedding
+    margin: ArcMarginHead
+
+    def __call__(self, x, labels=None, train: bool = True):
+        feat = self.backbone(x, train=train)
+        emb = self.embedding(feat)
+        return self.margin(emb, labels)
+
+
+class NestedModel(nn.Module):
+    """NetFeat + NetClassifier with a feature mask slot (NESTED shape,
+    model/model.py:12-76). `mask=None` → unmasked logits."""
+
+    backbone: nn.Module
+    classifier: NetClassifier
+
+    def __call__(self, x, mask=None, train: bool = True):
+        feat = self.backbone(x, train=train)
+        if mask is not None:
+            feat = feat * mask
+        return self.classifier(feat)
+
+    def features(self, x, train: bool = False):
+        return self.backbone(x, train=train)
+
+
+def build_model(cfg: ModelConfig, num_classes: int,
+                axis_name: Optional[str] = None) -> nn.Module:
+    if cfg.head == "fc":
+        return ClassifierModel(build_backbone(cfg, num_classes, axis_name))
+    if cfg.head == "arcface":
+        return ArcFaceModel(
+            backbone=build_backbone(cfg, 0, axis_name),
+            embedding=ArcEmbedding(dims=(512, cfg.arc_embed_dim)),
+            margin=ArcMarginHead(
+                num_classes=num_classes, in_features=cfg.arc_embed_dim,
+                s=cfg.arc_s, m=cfg.arc_m, easy_margin=cfg.arc_easy_margin,
+            ),
+        )
+    if cfg.head == "nested":
+        return NestedModel(
+            backbone=build_backbone(cfg, 0, axis_name),
+            classifier=NetClassifier(num_classes),
+        )
+    raise ValueError(f"unknown head {cfg.head!r}")

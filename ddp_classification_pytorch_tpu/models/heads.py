@@ -1,0 +1,90 @@
+"""Classifier heads.
+
+- `FCHead`: plain Linear head (`NewFC`, ARCFACE/arc_main.py:106-113; also the
+  torchvision fc replacement BASELINE/main.py:136-139).
+- `ArcEmbedding`: the ARCFACE backbone tail 2048→512→ReLU→256
+  (arc_main.py:223-231). The reference appends LogSoftmax to the *feature*
+  output (:230) — almost certainly a bug (features are re-normalized inside
+  the margin product anyway); reproduce it only with `log_softmax_quirk`.
+- `ArcMarginHead`: owns the (C, D) class-weight matrix and applies
+  `ops.arcface.arc_margin_logits`. Weight is float32, xavier-uniform
+  (arc_main.py:146-147), and carries a `sharding` annotation so the class dim
+  can be tensor-sharded over the mesh `model` axis.
+- `NetClassifier`: bias-free Dense (NESTED/model/model.py:64-76).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from ..ops.arcface import arc_margin_logits
+
+
+class FCHead(nn.Module):
+    num_classes: int
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
+        return nn.Dense(self.num_classes, dtype=jnp.float32, name="fc")(x.astype(jnp.float32))
+
+
+class ArcEmbedding(nn.Module):
+    """2048 → 512 → ReLU → 256 embedding (arc_main.py:223-231)."""
+
+    dims: Sequence[int] = (512, 256)
+    log_softmax_quirk: bool = False
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
+        x = x.astype(jnp.float32)
+        x = nn.Dense(self.dims[0], name="fc1")(x)
+        x = nn.relu(x)
+        x = nn.Dense(self.dims[1], name="fc2")(x)
+        if self.log_softmax_quirk:
+            x = nn.log_softmax(x, axis=-1)
+        return x
+
+
+class ArcMarginHead(nn.Module):
+    """ArcMarginProduct (arc_main.py:130-176) as a Flax module.
+
+    __call__(features, labels) → (B, C) scaled margin logits for CE.
+    `cosine_only` path (labels=None) returns s·cosθ for inference scoring.
+    """
+
+    num_classes: int
+    in_features: int
+    s: float = 30.0
+    m: float = 0.5
+    easy_margin: bool = False
+
+    @nn.compact
+    def __call__(self, features: jnp.ndarray, labels: Optional[jnp.ndarray] = None) -> jnp.ndarray:
+        weight = self.param(
+            "weight",
+            nn.initializers.xavier_uniform(),
+            (self.num_classes, self.in_features),
+            jnp.float32,
+        )
+        if labels is None:
+            f = features.astype(jnp.float32)
+            f = f / jnp.maximum(jnp.linalg.norm(f, axis=1, keepdims=True), 1e-12)
+            w = weight / jnp.maximum(jnp.linalg.norm(weight, axis=1, keepdims=True), 1e-12)
+            return (f @ w.T) * self.s
+        return arc_margin_logits(features, weight, labels, self.s, self.m, self.easy_margin)
+
+
+class NetClassifier(nn.Module):
+    """Bias-free linear classifier on (possibly masked) features
+    (NESTED/model/model.py:64-76)."""
+
+    num_classes: int
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
+        return nn.Dense(self.num_classes, use_bias=False, dtype=jnp.float32, name="fc")(
+            x.astype(jnp.float32)
+        )

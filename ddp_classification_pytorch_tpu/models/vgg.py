@@ -1,0 +1,72 @@
+"""Flax VGG19-BN — parity with the reference's VGG feature wrapper
+(NESTED/model/vgg.py:10-76, the 'Animal'-dataset NetFeat variant; dead code
+upstream but part of the capability surface).
+
+The reference splits torchvision's classifier into forward1 (→ 4096-d
+feature) and forward2 (→ logits) so a nested-dropout mask can be injected
+between them (vgg.py:37-55). Here the same split is `features_only` plus the
+separate head modules in `heads.py`.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Optional, Sequence
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+# torchvision cfg 'E' (VGG-19): numbers are conv output channels, 'M' = maxpool
+_CFG_E: Sequence[Any] = [
+    64, 64, "M", 128, 128, "M", 256, 256, 256, 256, "M",
+    512, 512, 512, 512, "M", 512, 512, 512, 512, "M",
+]
+
+
+class VGG(nn.Module):
+    """VGG with BatchNorm. `num_classes=0` → 4096-d feature (forward1 role);
+    otherwise full classifier to logits."""
+
+    cfg: Sequence[Any]
+    num_classes: int = 0
+    dtype: jnp.dtype = jnp.bfloat16
+    axis_name: Optional[str] = None
+    dropout: float = 0.5
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray, train: bool = True) -> jnp.ndarray:
+        conv = functools.partial(nn.Conv, kernel_size=(3, 3), dtype=self.dtype, padding="SAME")
+        norm = functools.partial(
+            nn.BatchNorm, use_running_average=not train, momentum=0.9,
+            epsilon=1e-5, dtype=self.dtype,
+            axis_name=self.axis_name if train else None,
+        )
+        x = x.astype(self.dtype)
+        i = 0
+        for v in self.cfg:
+            if v == "M":
+                x = nn.max_pool(x, (2, 2), strides=(2, 2))
+            else:
+                x = conv(features=v, name=f"conv{i}")(x)
+                x = norm(name=f"bn{i}")(x)
+                x = nn.relu(x)
+                i += 1
+        # torchvision adaptive-avg-pools to 7×7 then flattens; for 224² inputs
+        # the grid is already 7×7 — mean-pool handles other sizes gracefully.
+        if x.shape[1] != 7 or x.shape[2] != 7:
+            x = jnp.mean(x, axis=(1, 2), keepdims=True)
+            x = jnp.tile(x, (1, 7, 7, 1))
+        x = x.reshape((x.shape[0], -1)).astype(jnp.float32)
+        x = nn.Dense(4096, name="fc1")(x)
+        x = nn.relu(x)
+        x = nn.Dropout(self.dropout, deterministic=not train)(x)
+        x = nn.Dense(4096, name="fc2")(x)  # feature head (vgg.py forward1 ends here)
+        if self.num_classes > 0:
+            x = nn.relu(x)
+            x = nn.Dropout(self.dropout, deterministic=not train)(x)
+            x = nn.Dense(self.num_classes, name="fc3")(x)
+        return x
+
+
+def vgg19_bn(num_classes: int = 0, **kw: Any) -> VGG:
+    return VGG(cfg=_CFG_E, num_classes=num_classes, **kw)

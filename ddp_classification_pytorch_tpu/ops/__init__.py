@@ -1,0 +1,9 @@
+from .arcface import arc_margin_logits, arcface_naive_log_logits
+from .nested import gaussian_dist, sample_mask_dims, prefix_mask, nested_all_k_logits
+from .cdr import cdr_gradient_transform
+
+__all__ = [
+    "arc_margin_logits", "arcface_naive_log_logits",
+    "gaussian_dist", "sample_mask_dims", "prefix_mask", "nested_all_k_logits",
+    "cdr_gradient_transform",
+]

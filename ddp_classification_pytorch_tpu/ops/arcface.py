@@ -1,0 +1,86 @@
+"""ArcFace margin math — pure functions, float32.
+
+Parity targets:
+- `ArcMarginProduct.forward` (ARCFACE/arc_main.py:157-176): normalize features
+  and weight rows, phi = cos(θ+m) via the cos/sin expansion with a clamped
+  sqrt, easy-margin / threshold switch, one-hot splice, scale by s.
+- `ArcFaceNet.forward` (ARCFACE/arc_main.py:120-129): the naive acos/exp
+  formulation with its `/10` underflow guard.
+
+Kept in float32 regardless of the backbone's compute dtype — the clamped sqrt
+near cos²θ≈1 and the acos both lose precision catastrophically in bf16
+(SURVEY §7.3 #5).
+
+The class dimension is the sharding axis of interest (2173 classes here;
+ArcFace heads scale to 10⁵-10⁶ identities). Because these are pure jnp ops
+under jit, sharding `weight` over a mesh `model` axis makes XLA compute the
+(B, C) cosine tile-locally and the downstream softmax-cross-entropy with the
+necessary collectives — no code change needed (see parallel/sharding.py).
+"""
+
+from __future__ import annotations
+
+import math
+
+import jax.numpy as jnp
+
+
+def _l2_normalize(x: jnp.ndarray, axis: int, eps: float = 1e-12) -> jnp.ndarray:
+    # torch F.normalize semantics: x / max(||x||, eps)
+    n = jnp.linalg.norm(x, axis=axis, keepdims=True)
+    return x / jnp.maximum(n, eps)
+
+
+def arc_margin_logits(
+    features: jnp.ndarray,
+    weight: jnp.ndarray,
+    labels: jnp.ndarray,
+    s: float = 30.0,
+    m: float = 0.5,
+    easy_margin: bool = False,
+) -> jnp.ndarray:
+    """Large-margin arc logits (arc_main.py:157-176).
+
+    features: (B, D); weight: (C, D) — torch `F.linear` convention; labels: (B,).
+    Returns (B, C) scaled logits for cross-entropy.
+    """
+    features = features.astype(jnp.float32)
+    weight = weight.astype(jnp.float32)
+    cos_m, sin_m = math.cos(m), math.sin(m)
+    th = math.cos(math.pi - m)
+    mm = math.sin(math.pi - m) * m
+
+    cosine = _l2_normalize(features, 1) @ _l2_normalize(weight, 1).T
+    sine = jnp.sqrt(jnp.clip(1.0 - cosine**2, 0.0, 1.0))
+    phi = cosine * cos_m - sine * sin_m
+    if easy_margin:
+        phi = jnp.where(cosine > 0, phi, cosine)
+    else:
+        # past the flip point cos(θ+m) stops being monotonic; fall back to a
+        # linear penalty (standard ArcFace trick, arc_main.py:164-165)
+        phi = jnp.where(cosine > th, phi, cosine - mm)
+    one_hot = jnp.zeros_like(cosine).at[jnp.arange(labels.shape[0]), labels].set(1.0)
+    return (one_hot * phi + (1.0 - one_hot) * cosine) * s
+
+
+def arcface_naive_log_logits(
+    features: jnp.ndarray,
+    weight_dc: jnp.ndarray,
+    m: float = 1.0,
+    s: float = 10.0,
+) -> jnp.ndarray:
+    """The reference's naive ArcFaceNet forward (arc_main.py:120-129).
+
+    weight_dc: (D, C), normalized per column (dim=0 upstream). Returns
+    log(softmax-with-margin) per class, including the `/10` argument guard
+    that keeps acos in range (:125).
+    """
+    features = features.astype(jnp.float32)
+    weight_dc = weight_dc.astype(jnp.float32)
+    f = _l2_normalize(features, 1)
+    w = _l2_normalize(weight_dc, 0)
+    theta = jnp.arccos(jnp.clip((f @ w) / 10.0, -1.0, 1.0))
+    numerator = jnp.exp(s * jnp.cos(theta + m))
+    plain = jnp.exp(s * jnp.cos(theta))
+    denominator = jnp.sum(plain, axis=1, keepdims=True) - plain + numerator
+    return jnp.log(numerator / denominator)

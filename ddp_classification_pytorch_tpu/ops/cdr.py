@@ -1,0 +1,101 @@
+"""CDR selective-gradient step as an optax gradient transformation.
+
+Parity target: `train_one_step` (CDR/main.py:179-215) — after backward,
+flatten the gradients of every 2-D/4-D parameter (linear + conv kernels),
+rank elements by |g·v| (gradient × value), keep only the top
+`nonzero_ratio` fraction (global threshold over ~25M elements), scale the
+survivors by `clip`, and zero the rest. BN/bias (1-D) gradients pass through
+untouched.
+
+TPU-first: the whole transform runs inside the jitted train step — flatten,
+`lax.top_k` threshold, and masking are one fused XLA computation with no host
+round-trips (the reference pays a GPU→host sync per step for `thresh`).
+
+Schedule quirk (CDR/main.py:222-227): the gradual `clip` schedule
+`linspace(1-noise_rate, 1)[::-1][epoch]` is computed but immediately
+overwritten by the constant `1 - noise_rate`. `cdr_clip_schedule` implements
+the *intended* gradual schedule; pass `dead_schedule=True` (default, matching
+the reference's actual behavior) to get the constant.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+
+def _is_selected(p: jnp.ndarray) -> bool:
+    # torch `param.dim() in [2, 4]` (CDR/main.py:190): Linear weights are 2-D,
+    # conv kernels 4-D. Flax Dense kernels are 2-D and Conv kernels 4-D too,
+    # so the same rank test selects the same parameter population.
+    return p.ndim in (2, 4)
+
+
+def cdr_clip_schedule(noise_rate: float, num_gradual: int, n_epochs: int,
+                      dead_schedule: bool = True) -> np.ndarray:
+    """Per-epoch clip values. Intended (CDR/main.py:222-226): ramp from 1 down
+    to 1-noise_rate over `num_gradual` epochs. Actual reference behavior
+    (dead_schedule=True, :227): constant 1-noise_rate from epoch 0."""
+    if dead_schedule:
+        return np.full(n_epochs, 1.0 - noise_rate, dtype=np.float32)
+    ramp = np.linspace(1.0 - noise_rate, 1.0, num=num_gradual)[::-1]
+    out = np.full(n_epochs, 1.0 - noise_rate, dtype=np.float32)
+    out[: min(num_gradual, n_epochs)] = ramp[: min(num_gradual, n_epochs)]
+    return out
+
+
+class CDRState(NamedTuple):
+    pass
+
+
+def cdr_gradient_transform(
+    nonzero_ratio: float,
+    clip: Optional[float] = None,
+) -> optax.GradientTransformationExtraArgs:
+    """optax transform applying the CDR top-|g·v| mask.
+
+    `nonzero_ratio` may be a python float (static fraction); `clip` defaults
+    to `nonzero_ratio` exactly as the reference calls it
+    (CDR/main.py:243 passes clip == nonzero_ratio == 1-noise_rate).
+    The caller may instead thread a per-epoch `clip` array through
+    `update(..., clip=...)` for the intended gradual schedule.
+    """
+    if clip is None:
+        clip = nonzero_ratio
+
+    def init_fn(params):
+        del params
+        return CDRState()
+
+    def update_fn(updates, state, params=None, *, clip_override=None, **extra):
+        del extra
+        if params is None:
+            raise ValueError("cdr_gradient_transform requires params")
+        clip_val = clip if clip_override is None else clip_override
+
+        leaves_g, treedef = jax.tree_util.tree_flatten(updates)
+        leaves_v = jax.tree_util.tree_leaves(params)
+        sel = [_is_selected(v) for v in leaves_v]
+
+        flat_g = jnp.concatenate([g.ravel() for g, s in zip(leaves_g, sel) if s])
+        flat_v = jnp.concatenate([v.ravel() for v, s in zip(leaves_v, sel) if s])
+        metric = jnp.abs(flat_g * flat_v)
+        num = flat_g.shape[0]  # static at trace time
+        nz = max(int(nonzero_ratio * num), 1)
+        # global threshold = nz-th largest |g·v| (CDR/main.py:195-198)
+        thresh = jax.lax.top_k(metric, nz)[0][-1]
+
+        new_leaves = []
+        for g, v, s in zip(leaves_g, leaves_v, sel):
+            if s:
+                mask = (jnp.abs(v * g) >= thresh).astype(g.dtype) * clip_val
+                new_leaves.append(g * mask)
+            else:
+                new_leaves.append(g)
+        return jax.tree_util.tree_unflatten(treedef, new_leaves), state
+
+    return optax.GradientTransformationExtraArgs(init_fn, update_fn)

@@ -1,0 +1,105 @@
+"""Nested-Dropout ops: Gaussian prefix-dim distribution, mask sampling, and a
+vectorized all-K evaluation.
+
+Parity targets:
+- `GaussianDist(mu, std, N)` (NESTED/train.py:93-97): p_i ∝ exp(-((i-mu)/std)²)
+  over i = 1..N.
+- training mask (train.py:247-250): sample k ~ dist over range(feat_dim), keep
+  the first k+1 feature dims.
+- `TestNested` (train.py:103-166): evaluate the classifier at EVERY truncation
+  K and pick the best-accuracy K with a 1e-5·K tiebreak toward smaller K.
+
+TPU-first redesign of the eval: the reference runs 2048 separate classifier
+forwards per batch (train.py:122-124). Here one `lax.scan` over feature-dim
+blocks carries the running logits (B, C); each step adds a (B, G, C)
+cumulative-contribution tile — a single fused batched matmul per block on the
+MXU — and reduces straight to per-K correct counts, so the full K-sweep costs
+one pass over the weight matrix and never materializes (K, B, C).
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def gaussian_dist(mu: float, std: float, n: int) -> np.ndarray:
+    """p_i ∝ exp(-((i-mu)/std)²), i = 1..n (NESTED/train.py:93-97)."""
+    i = np.arange(1, n + 1, dtype=np.float64)
+    d = np.exp(-(((i - mu) / std) ** 2))
+    return (d / d.sum()).astype(np.float32)
+
+
+def sample_mask_dims(key: jax.Array, dist: jnp.ndarray, shape: Tuple[int, ...] = ()) -> jnp.ndarray:
+    """Sample k (number of kept dims - 1) from the prefix distribution —
+    `np.random.choice(range(D), p=dist)` (train.py:248) as a jit-safe op."""
+    return jax.random.choice(key, dist.shape[0], shape=shape, p=dist)
+
+
+def prefix_mask(k: jnp.ndarray, feat_dim: int, dtype=jnp.float32) -> jnp.ndarray:
+    """mask[d] = 1 for d <= k, else 0 — keeps the first k+1 dims
+    (train.py:358-362). Broadcastable against (..., feat_dim)."""
+    return (jnp.arange(feat_dim) <= k[..., None]).astype(dtype)
+
+
+def nested_all_k_logits(features: jnp.ndarray, weight: jnp.ndarray) -> jnp.ndarray:
+    """Exact logits for every truncation K — test oracle, O(B·D·C) memory.
+
+    features: (B, D); weight: (C, D) bias-free classifier
+    (NESTED/model/model.py:64-76). Returns (D, B, C): logits_K = (f ⊙ m_K) Wᵀ.
+    """
+    contrib = jnp.einsum("bd,cd->bdc", features.astype(jnp.float32), weight.astype(jnp.float32))
+    return jnp.moveaxis(jnp.cumsum(contrib, axis=1), 1, 0)
+
+
+def nested_all_k_counts(
+    features: jnp.ndarray,
+    weight: jnp.ndarray,
+    labels: jnp.ndarray,
+    block: int = 128,
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Per-K top-1 and top-3 correct counts for one batch, all K in one pass.
+
+    Replaces the reference's per-K classifier loop (train.py:122-133) with a
+    blocked cumulative matmul: scan over D/block feature blocks, carry the
+    running logits (B, C), emit correct counts for the `block` K values inside
+    each block. Returns two (D,) count vectors.
+    """
+    b, d = features.shape
+    c = weight.shape[0]
+    assert d % block == 0, f"feat_dim {d} must be divisible by block {block}"
+    f32, w32 = features.astype(jnp.float32), weight.astype(jnp.float32)
+    # (n_blocks, B, G) features and (n_blocks, G, C) weight slices
+    f_blocks = jnp.moveaxis(f32.reshape(b, d // block, block), 1, 0)
+    w_blocks = jnp.moveaxis(w32.T.reshape(d // block, block, c), 0, 0)
+
+    def step(carry_logits, blk):
+        fb, wb = blk  # (B, G), (G, C)
+        # within-block cumulative contributions: (B, G, C)
+        contrib = fb[:, :, None] * wb[None, :, :]
+        cum = carry_logits[:, None, :] + jnp.cumsum(contrib, axis=1)
+        # top-3 membership per K without full sort: count logits strictly
+        # greater than the true-class logit
+        true_logit = jnp.take_along_axis(
+            cum, labels[:, None, None].astype(jnp.int32), axis=2
+        )  # (B, G, 1)
+        rank = jnp.sum(cum > true_logit, axis=2)  # (B, G) number above true
+        top1 = jnp.sum(rank < 1, axis=0)  # (G,)
+        top3 = jnp.sum(rank < 3, axis=0)
+        return cum[:, -1, :], (top1, top3)
+
+    init = jnp.zeros((b, c), jnp.float32)
+    _, (t1, t3) = jax.lax.scan(step, init, (f_blocks, w_blocks))
+    return t1.reshape(d), t3.reshape(d)
+
+
+def best_k(true_pred: jnp.ndarray, nb_sample: jnp.ndarray) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Best truncation: argmax over acc_K − 1e-5·K (train.py:143) — the
+    tiebreak prefers the smallest K at equal accuracy."""
+    d = true_pred.shape[0]
+    score = true_pred / nb_sample - 1e-5 * jnp.arange(d, dtype=jnp.float32)
+    k = jnp.argmax(score)
+    return true_pred[k] / nb_sample, k

@@ -1,0 +1,9 @@
+from .mesh import (
+    MeshSpec, make_mesh, batch_sharding, replicated, make_global_array,
+    param_shardings,
+)
+
+__all__ = [
+    "MeshSpec", "make_mesh", "batch_sharding", "replicated",
+    "make_global_array", "param_shardings",
+]

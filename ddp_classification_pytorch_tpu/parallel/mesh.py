@@ -1,0 +1,112 @@
+"""Device mesh construction and sharding rules.
+
+This module is the whole replacement for the reference's distribution layer
+(SURVEY §2.3): `dist.init_process_group('nccl', ...)` + DDP + SyncBatchNorm +
+DistributedSampler (BASELINE/main.py:35-38,127-131,147-149) collapse into
+
+    mesh = make_mesh()                       # ('data', 'model') over ICI/DCN
+    batch = make_global_array(host_batch, mesh)   # per-host shard → jax.Array
+    step  = jax.jit(train_step, in_shardings=..., donate_argnums=...)
+
+XLA then inserts the gradient allreduce (implicit in the sharded-batch mean),
+the BN cross-replica stats, and any tensor-parallel collectives — over ICI
+when the axis fits inside a slice, DCN across slices. There is nothing to
+rendezvous: on pods, `jax.distributed.initialize()` is the only setup call.
+
+The 'model' axis exists for class-dim tensor parallelism of wide heads
+(ArcFace identity matrices) — the vision analogue of sequence parallelism
+(SURVEY §5). Default mesh shape puts all devices on 'data'.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+DATA_AXIS = "data"
+MODEL_AXIS = "model"
+
+
+@dataclasses.dataclass(frozen=True)
+class MeshSpec:
+    """data_parallel=0 → all devices on the data axis."""
+
+    data_parallel: int = 0
+    model_parallel: int = 1
+
+    def resolve(self, n_devices: int) -> tuple[int, int]:
+        mp = max(self.model_parallel, 1)
+        dp = self.data_parallel or n_devices // mp
+        if dp * mp != n_devices:
+            raise ValueError(
+                f"mesh {dp}×{mp} does not cover {n_devices} devices"
+            )
+        return dp, mp
+
+
+def make_mesh(spec: MeshSpec = MeshSpec(), devices: Optional[Sequence[Any]] = None) -> Mesh:
+    devices = list(devices) if devices is not None else jax.devices()
+    dp, mp = spec.resolve(len(devices))
+    arr = np.asarray(devices).reshape(dp, mp)
+    return Mesh(arr, (DATA_AXIS, MODEL_AXIS))
+
+
+def batch_sharding(mesh: Mesh) -> NamedSharding:
+    """Leading-axis (batch) sharding over the data axis."""
+    return NamedSharding(mesh, P(DATA_AXIS))
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())
+
+
+def make_global_array(host_batch: Any, mesh: Mesh) -> Any:
+    """Assemble per-host numpy batches into a globally batch-sharded
+    jax.Array (the H2D step; replaces `.cuda(non_blocking=True)` +
+    DistributedSampler semantics, BASELINE/main.py:273-274)."""
+    sharding = batch_sharding(mesh)
+
+    def put(x):
+        x = np.asarray(x)
+        global_shape = (x.shape[0] * jax.process_count(),) + x.shape[1:]
+        return jax.make_array_from_process_local_data(sharding, x, global_shape)
+
+    return jax.tree_util.tree_map(put, host_batch)
+
+
+# -------------------------------------------------------------- parameters --
+
+def _spec_for_param(path: str, value: Any, model_axis_size: int) -> P:
+    """Sharding rule for one parameter.
+
+    Everything is replicated under pure DP. With a >1 'model' axis, the wide
+    class-dim matrices are sharded on their class dimension:
+    - ArcMarginHead 'weight' (C, D) → P('model', None)
+    - final fc / NetClassifier kernels (D, C) → P(None, 'model')
+    This is the ArcFace-at-10⁶-identities headroom (SURVEY §5): the (B, C)
+    logits then shard over 'model' and XLA turns softmax-CE into a
+    psum-over-axis reduction.
+    """
+    if model_axis_size <= 1:
+        return P()
+    if "margin" in path and path.endswith("weight']") and value.ndim == 2:
+        return P(MODEL_AXIS, None)
+    if value.ndim == 2 and "kernel" in path and (
+            "classifier" in path or "']['fc']" in path):
+        return P(None, MODEL_AXIS)
+    return P()
+
+
+def param_shardings(variables: Any, mesh: Mesh) -> Any:
+    """NamedSharding pytree matching `variables` (params + batch_stats)."""
+    mp = mesh.shape[MODEL_AXIS]
+    flat, treedef = jax.tree_util.tree_flatten_with_path(variables)
+    specs = [
+        NamedSharding(mesh, _spec_for_param(jax.tree_util.keystr(path), value, mp))
+        for path, value in flat
+    ]
+    return jax.tree_util.tree_unflatten(treedef, specs)

@@ -1,0 +1,5 @@
+from .state import TrainState, create_train_state
+from .schedule import build_schedule, build_optimizer
+from .loop import Trainer
+
+__all__ = ["TrainState", "create_train_state", "build_schedule", "build_optimizer", "Trainer"]

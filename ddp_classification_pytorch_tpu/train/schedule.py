@@ -1,0 +1,87 @@
+"""LR schedules + optimizer assembly.
+
+Parity targets (SURVEY C26/C27):
+- StepLR(step_size=10, gamma=0.1) — BASELINE/main.py:154, ARCFACE:255
+- MultiStepLR(milestones) — CDR/main.py:340, NESTED/train.py:423
+- linear per-iteration warmup from 1e-6 to target lr — BASELINE `WarmUp`
+  :170-197, NESTED `LrWarmUp` :276-327 (both step the lr every iteration)
+- SGD(momentum=0.9) / Adam switch — BASELINE:153, ARCFACE:248-253
+- CDR selective-gradient transform chained before SGD (CDR/main.py:179-215)
+- NESTED freeze-BN: BN scale/bias receive no updates
+  (NESTED/model/model.py:44-55 freezes BN weights by eval()+no-grad)
+
+The reference mutates `optimizer.param_groups[*]['lr']` imperatively; here the
+whole schedule is one pure `schedule(step) -> lr` function baked into the
+jitted update — no host round-trip per step.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+import optax
+
+from ..config import OptimConfig
+from ..ops.cdr import cdr_gradient_transform
+
+
+def build_schedule(cfg: OptimConfig, steps_per_epoch: int) -> optax.Schedule:
+    if cfg.schedule == "step":
+        # lr · γ^(epoch // step_size)
+        main = optax.exponential_decay(
+            cfg.lr, transition_steps=cfg.step_size * steps_per_epoch,
+            decay_rate=cfg.gamma, staircase=True,
+        )
+    elif cfg.schedule == "multistep":
+        main = optax.piecewise_constant_schedule(
+            cfg.lr,
+            {int(m) * steps_per_epoch: cfg.gamma for m in cfg.milestones},
+        )
+    elif cfg.schedule == "constant":
+        main = optax.constant_schedule(cfg.lr)
+    else:
+        raise ValueError(f"unknown schedule {cfg.schedule!r}")
+
+    if cfg.warmup_iters > 0:
+        warm = optax.linear_schedule(cfg.warmup_start_lr, cfg.lr, cfg.warmup_iters)
+        return optax.join_schedules([warm, main], [cfg.warmup_iters])
+    return main
+
+
+def _is_bn_param(path, _value) -> bool:
+    keys = "/".join(str(getattr(k, "key", k)) for k in path).lower()
+    return "batchnorm" in keys or "bn_" in keys or keys.endswith("_bn") or "/bn" in keys
+
+
+def build_optimizer(
+    cfg: OptimConfig,
+    steps_per_epoch: int,
+    freeze_bn: bool = False,
+) -> optax.GradientTransformationExtraArgs:
+    schedule = build_schedule(cfg, steps_per_epoch)
+    if cfg.optimizer == "sgd":
+        base = optax.sgd(schedule, momentum=cfg.momentum)
+    elif cfg.optimizer == "adam":
+        base = optax.adam(schedule)
+    else:
+        raise ValueError(f"unknown optimizer {cfg.optimizer!r}")
+
+    parts = []
+    if cfg.grad_transform == "cdr":
+        clip = None if not cfg.cdr_dead_schedule else (1.0 - cfg.noise_rate)
+        parts.append(cdr_gradient_transform(1.0 - cfg.noise_rate, clip))
+    if cfg.weight_decay:
+        parts.append(optax.add_decayed_weights(cfg.weight_decay))
+    parts.append(base)
+    if freeze_bn:
+        # zero out BN parameter updates (running stats are already frozen by
+        # the model's freeze_bn flag)
+        parts.append(
+            optax.masked(
+                optax.set_to_zero(),
+                lambda params: __import__("jax").tree_util.tree_map_with_path(
+                    _is_bn_param, params
+                ),
+            )
+        )
+    return optax.with_extra_args_support(optax.chain(*parts))

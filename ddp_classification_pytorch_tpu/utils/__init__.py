@@ -1,0 +1,4 @@
+from .metrics import topk_accuracy, top1_top3, AverageMeter
+from .seeding import set_seed
+
+__all__ = ["topk_accuracy", "top1_top3", "AverageMeter", "set_seed"]

@@ -1,0 +1,73 @@
+"""Accuracy metrics and running meters.
+
+Reference semantics (all verified against the source):
+
+- `accuracy(output, target, topk)` — standard top-k percentage
+  (BASELINE/main.py:156-168, NESTED/utils.py:32-46).
+- `getAcc(outputs, labels, batchsize)` — returns (top1, top3) fractions
+  (BASELINE/main.py:199-209). Its top-3 sums matches over the whole (k, B)
+  prediction matrix; since the true label appears at most once among the
+  top-k rows this equals standard top-3 accuracy.
+- `AverageMeter` — running mean (NESTED/utils.py:14-29).
+
+Implemented as pure jnp functions so they run inside jit on device; each also
+accepts numpy arrays on host.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence, Tuple
+
+import jax.numpy as jnp
+
+
+def topk_correct(logits: jnp.ndarray, labels: jnp.ndarray, k: int) -> jnp.ndarray:
+    """Number of samples whose true label is within the top-k logits.
+
+    jnp.argsort is descending-stable via negation; ties broken by index, which
+    matches torch.topk's largest=True, sorted=True behavior closely enough for
+    metric purposes.
+    """
+    k = min(k, logits.shape[-1])
+    top = jnp.argsort(-logits, axis=-1)[..., :k]
+    hit = (top == labels[..., None]).any(axis=-1)
+    return hit.sum()
+
+
+def topk_accuracy(
+    logits: jnp.ndarray, labels: jnp.ndarray, topk: Sequence[int] = (1,)
+) -> Tuple[jnp.ndarray, ...]:
+    """Standard top-k accuracy fractions (reference BASELINE/main.py:156-168
+    returns percentages; we return fractions — callers multiply by 100 for
+    display, matching getAcc's fraction convention at :199-209)."""
+    n = labels.shape[0]
+    return tuple(topk_correct(logits, labels, k) / n for k in topk)
+
+
+def top1_top3(logits: jnp.ndarray, labels: jnp.ndarray) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """The reference's `getAcc` pair (BASELINE/main.py:199-209): top-1 and
+    top-3 fractions of the batch."""
+    a1, a3 = topk_accuracy(logits, labels, (1, 3))
+    return a1, a3
+
+
+class AverageMeter:
+    """Running average (NESTED/utils.py:14-29)."""
+
+    def __init__(self) -> None:
+        self.reset()
+
+    def reset(self) -> None:
+        self.val = 0.0
+        self.sum = 0.0
+        self.count = 0
+
+    def update(self, val: float, n: int = 1) -> None:
+        val = float(val)
+        self.val = val
+        self.sum += val * n
+        self.count += n
+
+    @property
+    def avg(self) -> float:
+        return self.sum / max(self.count, 1)

@@ -1,0 +1,27 @@
+"""Seeding.
+
+The reference seeds random/numpy/torch/cuda with 999 (BASELINE/main.py:43-50).
+JAX is functional: all device-side randomness flows from explicit
+`jax.random.key` threading, so `set_seed` only needs to pin the host-side
+generators used by the data pipeline, and hands back a JAX key for the rest.
+"""
+
+from __future__ import annotations
+
+import random
+
+import numpy as np
+import jax
+
+
+def set_seed(seed: int = 999) -> jax.Array:
+    """Seed host RNGs and return the root JAX PRNG key."""
+    random.seed(seed)
+    np.random.seed(seed)
+    return jax.random.key(seed)
+
+
+def fold_in_epoch(key: jax.Array, epoch: int) -> jax.Array:
+    """Derive a per-epoch key — the functional analogue of
+    `DistributedSampler.set_epoch` (BASELINE/main.py:269)."""
+    return jax.random.fold_in(key, epoch)

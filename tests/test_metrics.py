@@ -1,0 +1,49 @@
+"""Metrics vs numpy oracles, incl. the reference getAcc conventions
+(BASELINE/main.py:156-168,199-209)."""
+
+import numpy as np
+import jax.numpy as jnp
+
+from ddp_classification_pytorch_tpu.utils.metrics import (
+    AverageMeter, top1_top3, topk_accuracy,
+)
+
+
+def _oracle_topk(logits, labels, k):
+    order = np.argsort(-logits, axis=1, kind="stable")[:, :k]
+    return np.mean([labels[i] in order[i] for i in range(len(labels))])
+
+
+def test_topk_matches_oracle():
+    rng = np.random.default_rng(0)
+    logits = rng.normal(size=(64, 10)).astype(np.float32)
+    labels = rng.integers(0, 10, size=64)
+    for k in (1, 3, 5):
+        (acc,) = topk_accuracy(jnp.asarray(logits), jnp.asarray(labels), (k,))
+        assert abs(float(acc) - _oracle_topk(logits, labels, k)) < 1e-6
+
+
+def test_top1_top3_pair():
+    logits = jnp.asarray(
+        [[5.0, 1.0, 0.0, -1.0], [0.0, 1.0, 2.0, 3.0], [1.0, 0.9, 0.8, 0.7]]
+    )
+    labels = jnp.asarray([0, 0, 2])
+    a1, a3 = top1_top3(logits, labels)
+    assert abs(float(a1) - 1 / 3) < 1e-6  # only sample 0 is top-1 correct
+    assert abs(float(a3) - 2 / 3) < 1e-6  # samples 0 and 2 within top-3
+
+
+def test_topk_k_larger_than_classes():
+    logits = jnp.asarray([[1.0, 0.0]])
+    labels = jnp.asarray([1])
+    (acc,) = topk_accuracy(logits, labels, (3,))
+    assert float(acc) == 1.0
+
+
+def test_average_meter():
+    m = AverageMeter()
+    m.update(1.0, 2)
+    m.update(4.0, 1)
+    assert abs(m.avg - 2.0) < 1e-9
+    m.reset()
+    assert m.avg == 0.0

@@ -1,0 +1,185 @@
+"""Algorithm cores vs numpy/torch-free oracles: ArcFace phi math
+(ARCFACE/arc_main.py:157-176), GaussianDist + masks (NESTED/train.py:93-97,
+247-250,358-362), nested all-K eval (train.py:103-143), CDR selective
+gradients (CDR/main.py:179-215)."""
+
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from ddp_classification_pytorch_tpu.ops.arcface import (
+    arc_margin_logits, arcface_naive_log_logits,
+)
+from ddp_classification_pytorch_tpu.ops.cdr import (
+    cdr_clip_schedule, cdr_gradient_transform,
+)
+from ddp_classification_pytorch_tpu.ops.nested import (
+    best_k, gaussian_dist, nested_all_k_counts, nested_all_k_logits,
+    prefix_mask, sample_mask_dims,
+)
+
+
+# ---------------------------------------------------------------- ArcFace ---
+
+def _numpy_arc_margin(f, w, labels, s, m, easy_margin):
+    f = f / np.maximum(np.linalg.norm(f, axis=1, keepdims=True), 1e-12)
+    wn = w / np.maximum(np.linalg.norm(w, axis=1, keepdims=True), 1e-12)
+    cos = f @ wn.T
+    sin = np.sqrt(np.clip(1 - cos**2, 0, 1))
+    phi = cos * math.cos(m) - sin * math.sin(m)
+    if easy_margin:
+        phi = np.where(cos > 0, phi, cos)
+    else:
+        th, mm = math.cos(math.pi - m), math.sin(math.pi - m) * m
+        phi = np.where(cos > th, phi, cos - mm)
+    one_hot = np.zeros_like(cos)
+    one_hot[np.arange(len(labels)), labels] = 1
+    return (one_hot * phi + (1 - one_hot) * cos) * s
+
+
+@pytest.mark.parametrize("easy_margin", [True, False])
+def test_arc_margin_vs_oracle(easy_margin):
+    rng = np.random.default_rng(0)
+    f = rng.normal(size=(8, 16)).astype(np.float32)
+    w = rng.normal(size=(12, 16)).astype(np.float32)
+    labels = rng.integers(0, 12, size=8)
+    got = arc_margin_logits(jnp.asarray(f), jnp.asarray(w), jnp.asarray(labels),
+                            s=30.0, m=0.5, easy_margin=easy_margin)
+    want = _numpy_arc_margin(f, w, labels, 30.0, 0.5, easy_margin)
+    np.testing.assert_allclose(np.asarray(got), want, atol=1e-5)
+
+
+def test_arc_margin_true_class_gets_margin_penalty():
+    """phi < cos for the true class ⇒ margin logits are strictly harder."""
+    rng = np.random.default_rng(1)
+    f = rng.normal(size=(4, 8)).astype(np.float32)
+    w = rng.normal(size=(6, 8)).astype(np.float32)
+    labels = np.array([0, 1, 2, 3])
+    with_margin = np.asarray(arc_margin_logits(
+        jnp.asarray(f), jnp.asarray(w), jnp.asarray(labels), s=1.0, m=0.5))
+    no_margin = np.asarray(arc_margin_logits(
+        jnp.asarray(f), jnp.asarray(w), jnp.asarray(labels), s=1.0, m=0.0))
+    rows = np.arange(4)
+    assert (with_margin[rows, labels] <= no_margin[rows, labels] + 1e-6).all()
+    off = ~np.eye(6, dtype=bool)[labels].reshape(4, 6).all(axis=1)
+    del off  # off-diagonal entries identical:
+    mask = np.ones_like(with_margin, bool)
+    mask[rows, labels] = False
+    np.testing.assert_allclose(with_margin[mask], no_margin[mask], atol=1e-5)
+
+
+def test_arcface_naive_shapes():
+    f = jnp.asarray(np.random.default_rng(2).normal(size=(4, 8)), jnp.float32)
+    w = jnp.asarray(np.random.default_rng(3).normal(size=(8, 5)), jnp.float32)
+    out = arcface_naive_log_logits(f, w)
+    assert out.shape == (4, 5)
+    assert bool(jnp.all(out <= 0.0))  # log of a probability-like ratio
+
+
+# ----------------------------------------------------------------- Nested ---
+
+def test_gaussian_dist_matches_reference_formula():
+    mu, std, n = 0.0, 100.0, 512
+    i = np.arange(1, n + 1)
+    want = np.exp(-(((i - mu) / std) ** 2))
+    want = want / want.sum()
+    np.testing.assert_allclose(gaussian_dist(mu, std, n), want, rtol=1e-6)
+    assert abs(gaussian_dist(0, 100, 2048).sum() - 1.0) < 1e-6
+
+
+def test_prefix_mask():
+    m = prefix_mask(jnp.asarray(2), 6)
+    np.testing.assert_array_equal(np.asarray(m), [1, 1, 1, 0, 0, 0])
+    batch = prefix_mask(jnp.asarray([0, 5]), 6)
+    assert batch.shape == (2, 6)
+    assert batch[0].sum() == 1 and batch[1].sum() == 6
+
+
+def test_sample_mask_dims_follows_dist():
+    dist = jnp.asarray(gaussian_dist(0, 10, 64))
+    ks = sample_mask_dims(jax.random.key(0), dist, (2000,))
+    # with std=10 over 64 dims, nearly all mass is below k=40
+    assert float(jnp.mean(ks < 40)) > 0.99
+
+
+def test_nested_all_k_logits_oracle():
+    rng = np.random.default_rng(4)
+    f = rng.normal(size=(3, 8)).astype(np.float32)
+    w = rng.normal(size=(5, 8)).astype(np.float32)
+    got = np.asarray(nested_all_k_logits(jnp.asarray(f), jnp.asarray(w)))
+    for k in range(8):
+        mask = np.zeros(8, np.float32)
+        mask[: k + 1] = 1
+        want = (f * mask) @ w.T
+        np.testing.assert_allclose(got[k], want, atol=1e-5)
+
+
+def test_nested_all_k_counts_matches_dense_path():
+    rng = np.random.default_rng(5)
+    b, d, c = 16, 32, 7
+    f = rng.normal(size=(b, d)).astype(np.float32)
+    w = rng.normal(size=(c, d)).astype(np.float32)
+    labels = rng.integers(0, c, size=b)
+    t1, t3 = nested_all_k_counts(jnp.asarray(f), jnp.asarray(w),
+                                 jnp.asarray(labels), block=8)
+    dense = np.asarray(nested_all_k_logits(jnp.asarray(f), jnp.asarray(w)))
+    for k in range(d):
+        order = np.argsort(-dense[k], axis=1, kind="stable")
+        want1 = sum(labels[i] == order[i, 0] for i in range(b))
+        want3 = sum(labels[i] in order[i, :3] for i in range(b))
+        assert int(t1[k]) == want1, k
+        assert int(t3[k]) == want3, k
+
+
+def test_best_k_tiebreak_prefers_small_k():
+    counts = jnp.asarray([5.0, 5.0, 5.0, 4.0])
+    acc, k = best_k(counts, jnp.asarray(10.0))
+    assert int(k) == 0 and abs(float(acc) - 0.5) < 1e-6
+
+
+# -------------------------------------------------------------------- CDR ---
+
+def test_cdr_clip_schedule():
+    dead = cdr_clip_schedule(0.2, 10, 5, dead_schedule=True)
+    np.testing.assert_allclose(dead, 0.8)
+    live = cdr_clip_schedule(0.2, 4, 6, dead_schedule=False)
+    np.testing.assert_allclose(live[:4], np.linspace(0.8, 1.0, 4)[::-1])
+    np.testing.assert_allclose(live[4:], 0.8)
+
+
+def test_cdr_transform_masks_bottom_gradients():
+    params = {
+        "w": jnp.asarray(np.arange(1, 11, dtype=np.float32).reshape(2, 5)),
+        "b": jnp.ones((5,), jnp.float32),  # 1-D: must pass through untouched
+    }
+    grads = {
+        "w": jnp.ones((2, 5), jnp.float32),
+        "b": jnp.full((5,), 7.0, jnp.float32),
+    }
+    tx = cdr_gradient_transform(nonzero_ratio=0.5)
+    state = tx.init(params)
+    new, _ = tx.update(grads, state, params)
+    # metric |g·v| = v itself here; top-5 of 10 elements ⇒ values ≥ 6 survive,
+    # scaled by clip=0.5
+    want = (np.arange(1, 11).reshape(2, 5) >= 6) * 0.5
+    np.testing.assert_allclose(np.asarray(new["w"]), want, atol=1e-6)
+    np.testing.assert_allclose(np.asarray(new["b"]), 7.0)
+
+
+def test_cdr_transform_in_chain_and_jit():
+    params = {"w": jnp.asarray(np.random.default_rng(6).normal(size=(4, 4)),
+                               jnp.float32)}
+    tx = optax.chain(cdr_gradient_transform(0.75), optax.sgd(0.1))
+    state = tx.init(params)
+
+    @jax.jit
+    def step(g, s, p):
+        return tx.update(g, s, p)
+
+    updates, _ = step({"w": jnp.ones((4, 4))}, state, params)
+    # 25% of gradient entries zeroed
+    assert int(np.sum(np.asarray(updates["w"]) == 0.0)) == 4
