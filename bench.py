@@ -1,0 +1,109 @@
+"""Benchmark harness — flagship training-step throughput.
+
+Measures the jitted ResNet-50 train step (bf16 compute, NHWC, global-batch
+sharded over all available devices) on synthetic device-resident data, and
+prints ONE JSON line:
+
+    {"metric": ..., "value": N, "unit": "images/sec/chip", "vs_baseline": N}
+
+The reference publishes no numbers (BASELINE.md); `vs_baseline` is therefore
+computed against a documented stand-in: 2500 images/sec/chip, the
+commonly-cited MLPerf-era ResNet-50 mixed-precision training throughput of a
+single A100 — the hardware class of the reference's own runs
+(BASELINE/train.sh uses 2 local GPUs). vs_baseline = value / 2500.
+
+Usage: python bench.py [--batch N] [--steps N] [--arch resnet50]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+A100_RESNET50_IMG_PER_SEC = 2500.0
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--arch", default="resnet50")
+    ap.add_argument("--batch", type=int, default=0, help="global batch; 0 = auto")
+    ap.add_argument("--image-size", type=int, default=224)
+    ap.add_argument("--steps", type=int, default=50)
+    ap.add_argument("--warmup", type=int, default=10)
+    args = ap.parse_args()
+
+    from ddp_classification_pytorch_tpu.config import get_preset
+    from ddp_classification_pytorch_tpu.parallel import mesh as meshlib
+    from ddp_classification_pytorch_tpu.train.state import create_train_state
+    from ddp_classification_pytorch_tpu.train.steps import make_train_step
+
+    devices = jax.devices()
+    n_chips = len(devices)
+    platform = devices[0].platform
+    on_accel = platform in ("tpu", "gpu")
+
+    cfg = get_preset("baseline")
+    cfg.model.arch = args.arch
+    cfg.model.dtype = "bfloat16" if on_accel else "float32"
+    cfg.data.num_classes = 1000
+    cfg.data.image_size = args.image_size if on_accel else 64
+    batch = args.batch or (256 * n_chips if on_accel else 8 * n_chips)
+    cfg.data.batch_size = batch
+    steps = args.steps if on_accel else 3
+    warmup = args.warmup if on_accel else 1
+
+    mesh = meshlib.make_mesh(devices=devices)
+    with mesh:
+        model, tx, state = create_train_state(cfg, mesh, steps_per_epoch=100)
+        step = make_train_step(cfg, model, tx)
+
+        rng = np.random.default_rng(0)
+        h = cfg.data.image_size
+        images = jax.device_put(
+            rng.normal(size=(batch, h, h, 3)).astype(np.float32),
+            meshlib.batch_sharding(mesh),
+        )
+        labels = jax.device_put(
+            rng.integers(0, cfg.data.num_classes, batch).astype(np.int32),
+            meshlib.batch_sharding(mesh),
+        )
+
+        for _ in range(warmup):
+            state, metrics = step(state, images, labels)
+        float(metrics["loss"])  # device_get: hard sync (block_until_ready does
+        # not reliably wait for remote/tunneled TPU execution)
+
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            state, metrics = step(state, images, labels)
+        float(metrics["loss"])  # hard sync closes the timing window
+        dt = time.perf_counter() - t0
+
+    img_per_sec = batch * steps / dt
+    per_chip = img_per_sec / n_chips
+    print(
+        json.dumps(
+            {
+                "metric": f"{args.arch}_train_images_per_sec_per_chip"
+                + ("" if on_accel else f"_{platform}"),
+                "value": round(per_chip, 2),
+                "unit": "images/sec/chip",
+                "vs_baseline": round(per_chip / A100_RESNET50_IMG_PER_SEC, 4),
+            }
+        )
+    )
+    print(
+        f"# {platform} x{n_chips}, global batch {batch}, image {h}px, "
+        f"{steps} steps in {dt:.2f}s, dtype {cfg.model.dtype}",
+        file=sys.stderr,
+    )
+
+
+if __name__ == "__main__":
+    main()

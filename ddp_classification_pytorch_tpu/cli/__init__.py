@@ -1,0 +1,1 @@
+from .train import main, build_parser  # noqa: F401

@@ -1,0 +1,236 @@
+"""Unified CLI — replaces the reference's four launch stacks.
+
+Reference (SURVEY L6): `torch.distributed.launch --nproc_per_node=N main.py
+--world_size=N --local_rank …` per silo (BASELINE/train.sh:1,
+ARCFACE/arc_train.sh:1, CDR/train.sh:1-4, NESTED/train.sh:1-7). On TPU there
+is no process-per-device launcher: ONE process per host sees all local chips,
+and `jax.distributed.initialize()` is the only multi-host setup. So
+`--nproc_per_node/--world_size/--local_rank` cease to exist by design — the
+`--device` branch the north star asks for is the `--platform` flag here.
+
+Every behavior-affecting reference flag maps to a field of the Config tree:
+
+    python -m ddp_classification_pytorch_tpu.cli.train baseline \
+        --folder /data/food --batchsize 16 --model resnet50 --lr 0.001
+    python -m ddp_classification_pytorch_tpu.cli.train arcface  --optimizer adam
+    python -m ddp_classification_pytorch_tpu.cli.train cdr      --noise_rate 0.2
+    python -m ddp_classification_pytorch_tpu.cli.train nested   --nested 100 \
+        --warmUpIter 10000 --freeze-bn
+"""
+
+from __future__ import annotations
+
+import argparse
+from typing import Optional, Sequence
+
+from ..config import Config, get_preset
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="ddp_classification_pytorch_tpu.cli.train",
+        description="TPU-native classification training (all reference workloads)",
+    )
+    p.add_argument("workload", choices=["baseline", "arcface", "cdr", "nested", "plc"],
+                   help="which reference silo's recipe to run")
+
+    d = p.add_argument_group("data")
+    d.add_argument("--folder", "-f", default="", help="dataset root containing "
+                   "train/val dirs (reference --folder, BASELINE/main.py:27)")
+    d.add_argument("--train_dir", default="", help="explicit train dir (overrides --folder)")
+    d.add_argument("--val_dir", default="", help="explicit val dir (overrides --folder)")
+    d.add_argument("--dataset", default="", help="imagefolder | synthetic | plc")
+    d.add_argument("--batchsize", "-b", type=int, default=0,
+                   help="PER-HOST batch size; the global batch is "
+                   "batchsize × num_hosts (cf. reference per-GPU batch, "
+                   "BASELINE/main.py:29)")
+    d.add_argument("--num_classes", type=int, default=0)
+    d.add_argument("--imgs_per_class", type=int, default=0,
+                   help="per-class cap (500 baseline / 400 arcface)")
+    d.add_argument("--num_workers", type=int, default=0, help="host loader threads")
+    d.add_argument("--image_size", type=int, default=0)
+
+    m = p.add_argument_group("model")
+    m.add_argument("--model", "--arch", dest="model", default="",
+                   help="resnet18/34/50/101/152 | vgg19_bn (reference --model)")
+    m.add_argument("--variant", default="", help="imagenet | cifar stem")
+    m.add_argument("--pretrained", action="store_true",
+                   help="load converted torchvision weights")
+    m.add_argument("--dtype", default="", help="bfloat16 | float32 compute dtype")
+    m.add_argument("--dropout", type=float, default=-1.0)
+
+    o = p.add_argument_group("optimization")
+    o.add_argument("--optimizer", default="", help="sgd | adam (arc_main.py:34-43)")
+    o.add_argument("--lr", type=float, default=0.0)
+    o.add_argument("--momentum", type=float, default=-1.0)
+    o.add_argument("--weight_decay", type=float, default=-1.0)
+    o.add_argument("--epochs", type=int, default=0)
+    o.add_argument("--lrSchedule", type=int, nargs="*", default=None,
+                   help="multistep milestones (NESTED/train.py:472)")
+    o.add_argument("--warmUpIter", type=int, default=-1,
+                   help="linear warmup iterations (NESTED/train.py:466)")
+
+    a = p.add_argument_group("arcface")
+    a.add_argument("--arc_s", type=float, default=-1.0)
+    a.add_argument("--arc_m", type=float, default=-1.0)
+    a.add_argument("--easy_margin", dest="easy_margin", default=None,
+                   action="store_true")
+
+    c = p.add_argument_group("cdr")
+    c.add_argument("--noise_rate", type=float, default=-1.0, help="CDR/main.py:37")
+    c.add_argument("--num_gradual", type=int, default=-1, help="CDR/main.py:41")
+    c.add_argument("--live_clip_schedule", action="store_true",
+                   help="use the reference's INTENDED gradual clip schedule "
+                   "instead of its actual dead-code constant (CDR/main.py:222-227)")
+
+    n = p.add_argument_group("nested")
+    n.add_argument("--nested", type=float, default=-1.0,
+                   help="Gaussian σ over feature dims (NESTED/train.py:512-530)")
+    n.add_argument("--freeze-bn", dest="freeze_bn", default=None, action="store_true")
+    n.add_argument("--resumePth", default="", help="NESTED/train.py:481")
+
+    pl = p.add_argument_group("plc")
+    pl.add_argument("--correction", default="", choices=["", "lrt", "prob"],
+                    help="label-correction method (PLC/utils.py:291,321)")
+    pl.add_argument("--delta", type=float, default=-1.0, help="initial θ threshold")
+    pl.add_argument("--delta_increment", type=float, default=-1.0, help="β step")
+    pl.add_argument("--thd", type=float, default=-1.0, help="prob-correction confidence")
+    pl.add_argument("--plc_warmup_epochs", type=int, default=-1)
+
+    r = p.add_argument_group("run")
+    r.add_argument("--seed", type=int, default=-1)
+    r.add_argument("--out", default="", help="output dir (records + checkpoints)")
+    r.add_argument("--resume", default="", help="checkpoint path to resume from")
+    r.add_argument("--log_every", type=int, default=0)
+    r.add_argument("--save_best_only", action="store_true")
+    r.add_argument("--platform", default="", choices=["", "tpu", "cpu"],
+                   help="force a JAX platform (the north star's --device branch); "
+                   "default: whatever jax finds (TPU when present)")
+
+    par = p.add_argument_group("parallelism")
+    par.add_argument("--dp", type=int, default=0,
+                     help="data-parallel mesh axis size (0 = all devices)")
+    par.add_argument("--mp", type=int, default=0,
+                     help="model-parallel axis (class-dim sharding of wide heads)")
+    par.add_argument("--multihost", action="store_true",
+                     help="call jax.distributed.initialize() (TPU pods)")
+    return p
+
+
+def config_from_args(args: argparse.Namespace) -> Config:
+    cfg = get_preset(args.workload)
+
+    if args.folder:
+        cfg.data.train_dir = f"{args.folder}/train"
+        cfg.data.val_dir = f"{args.folder}/val"
+    if args.train_dir:
+        cfg.data.train_dir = args.train_dir
+    if args.val_dir:
+        cfg.data.val_dir = args.val_dir
+    if args.dataset:
+        cfg.data.dataset = args.dataset
+    if args.batchsize:
+        cfg.data.batch_size = args.batchsize
+    if args.num_classes:
+        cfg.data.num_classes = args.num_classes
+    if args.imgs_per_class:
+        cfg.data.imgs_per_class = args.imgs_per_class
+    if args.num_workers:
+        cfg.data.num_workers = args.num_workers
+    if args.image_size:
+        cfg.data.image_size = args.image_size
+
+    if args.model:
+        cfg.model.arch = args.model
+    if args.variant:
+        cfg.model.variant = args.variant
+    if args.pretrained:
+        cfg.model.pretrained = True
+    if args.dtype:
+        cfg.model.dtype = args.dtype
+    if args.dropout >= 0:
+        cfg.model.dropout = args.dropout
+    if args.arc_s >= 0:
+        cfg.model.arc_s = args.arc_s
+    if args.arc_m >= 0:
+        cfg.model.arc_m = args.arc_m
+    if args.easy_margin is not None:
+        cfg.model.arc_easy_margin = args.easy_margin
+    if args.nested >= 0:
+        cfg.model.nested_std = args.nested
+    if args.freeze_bn is not None:
+        cfg.model.freeze_bn = args.freeze_bn
+
+    if args.optimizer:
+        cfg.optim.optimizer = args.optimizer
+    if args.lr:
+        cfg.optim.lr = args.lr
+    if args.momentum >= 0:
+        cfg.optim.momentum = args.momentum
+    if args.weight_decay >= 0:
+        cfg.optim.weight_decay = args.weight_decay
+    if args.lrSchedule is not None:
+        cfg.optim.schedule = "multistep"
+        cfg.optim.milestones = tuple(args.lrSchedule)
+    if args.warmUpIter >= 0:
+        cfg.optim.warmup_iters = args.warmUpIter
+    if args.noise_rate >= 0:
+        cfg.optim.noise_rate = args.noise_rate
+    if args.num_gradual >= 0:
+        cfg.optim.num_gradual = args.num_gradual
+    if args.live_clip_schedule:
+        cfg.optim.cdr_dead_schedule = False
+
+    if args.epochs:
+        cfg.run.epochs = args.epochs
+    if args.seed >= 0:
+        cfg.run.seed = args.seed
+    if args.out:
+        cfg.run.out_dir = args.out
+    if args.resume or args.resumePth:
+        cfg.run.resume = args.resume or args.resumePth
+    if args.log_every:
+        cfg.run.log_every = args.log_every
+    if args.save_best_only:
+        cfg.run.save_best_only = True
+
+    if args.correction:
+        cfg.plc.correction = args.correction
+    if args.delta >= 0:
+        cfg.plc.current_delta = args.delta
+    if args.delta_increment >= 0:
+        cfg.plc.delta_increment = args.delta_increment
+    if args.thd >= 0:
+        cfg.plc.thd = args.thd
+    if args.plc_warmup_epochs >= 0:
+        cfg.plc.warmup_epochs = args.plc_warmup_epochs
+
+    if args.dp:
+        cfg.parallel.data_axis = args.dp
+    if args.mp:
+        cfg.parallel.model_axis = args.mp
+    return cfg
+
+
+def main(argv: Optional[Sequence[str]] = None) -> None:
+    args = build_parser().parse_args(argv)
+    if args.platform:
+        import jax
+        jax.config.update("jax_platforms", args.platform)
+    if args.multihost:
+        import jax
+        jax.distributed.initialize()
+
+    from ..train.loop import Trainer
+    from ..train.plc_loop import PLCTrainer
+    from ..utils.seeding import set_seed
+
+    cfg = config_from_args(args)
+    set_seed(cfg.run.seed)
+    trainer_cls = PLCTrainer if cfg.workload == "plc" else Trainer
+    trainer = trainer_cls(cfg)
+    trainer.run()
+
+
+if __name__ == "__main__":
+    main()

@@ -39,6 +39,9 @@ class DataConfig:
     synthetic_size: int = 0  # for dataset == "synthetic"
     # transform preset: baseline | cdr | cifar | clothing1m (SURVEY C15)
     transform: str = "baseline"
+    # use the native C++ dataplane (libjpeg decode + fused transform) for
+    # supported presets; auto-falls back to the Python/PIL path
+    native_loader: bool = True
 
 
 @dataclass
@@ -111,6 +114,26 @@ class ParallelConfig:
 
 
 @dataclass
+class PLCConfig:
+    """Progressive-label-correction loop (PLC silo — the reference left it
+    '// TODO' with no training entry point, SURVEY §1; here it is a first-class
+    workload wiring `ops.labelnoise` corrections into the train loop via
+    `FolderDataset.update_corrupted_label` semantics, PLC/FolderDataset.py:80-82)."""
+
+    correction: str = "lrt"  # lrt | prob
+    current_delta: float = 0.3  # PLC/utils.py:291 θ
+    delta_increment: float = 0.1  # β
+    thd: float = 0.1  # prob_correction confidence threshold (:321)
+    warmup_epochs: int = 2  # epochs of plain training before correction starts
+    # collect f(x) with the prediction batch's own BN stats (as the reference
+    # harvests softmax during training, utils.py:269-271) vs running averages
+    batch_stat_predictions: bool = True
+    # synthetic-noise injection for experiments (utils.py:149-220); -1 = off
+    noise_type: int = -1
+    noise_factor: float = 1.2
+
+
+@dataclass
 class RunConfig:
     """Loop + IO. Epochs/ckpt/record semantics per BASELINE/main.py:258-317."""
 
@@ -133,6 +156,7 @@ class Config:
     optim: OptimConfig = field(default_factory=OptimConfig)
     parallel: ParallelConfig = field(default_factory=ParallelConfig)
     run: RunConfig = field(default_factory=RunConfig)
+    plc: PLCConfig = field(default_factory=PLCConfig)
 
     def replace(self, **kw: Any) -> "Config":
         return dataclasses.replace(self, **kw)
@@ -189,11 +213,27 @@ def nested_preset() -> Config:
     return cfg
 
 
+def plc_preset() -> Config:
+    """PLC correction training on Clothing1M-scale data: ResNet-50, batch 128,
+    LRT correction after 2 warmup epochs. The reference shipped the dataset +
+    algorithms but no trainer (README.md:12 'PLC // TODO'); recipe constants
+    follow the PLC paper defaults encoded in utils.py:291-360."""
+    cfg = Config(workload="plc")
+    cfg.data.batch_size = 128
+    cfg.data.num_classes = 14  # Clothing1M
+    cfg.optim.lr = 0.01
+    cfg.optim.schedule = "multistep"
+    cfg.optim.milestones = (10, 20)
+    cfg.run.epochs = 30
+    return cfg
+
+
 PRESETS = {
     "baseline": baseline_preset,
     "arcface": arcface_preset,
     "cdr": cdr_preset,
     "nested": nested_preset,
+    "plc": plc_preset,
 }
 
 

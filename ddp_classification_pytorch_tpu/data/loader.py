@@ -53,14 +53,16 @@ def shard_indices_for_host(
 
     idx = np.arange(n, dtype=np.int64)
     if shuffle:
-        rng = np.random.default_rng(np.uint32(seed) ^ np.uint32(epoch * 0x9E3779B9))
+        rng = np.random.default_rng(np.uint32(seed) ^ np.uint32((epoch * 0x9E3779B9) & 0xFFFFFFFF))
         rng.shuffle(idx)
     chunk = num_hosts * batch_size
     if drop_last:
         idx = idx[: (n // chunk) * chunk]
     elif n % chunk:
-        pad = chunk - n % chunk
-        idx = np.concatenate([idx, idx[:pad]])
+        # np.resize tiles the permutation, so padding wraps repeatedly even
+        # when the pad exceeds the dataset size (tiny val sets vs large
+        # num_hosts·batch_size)
+        idx = np.resize(idx, ((n // chunk) + 1) * chunk)
     per_host = len(idx) // num_hosts
     return idx[host_id * per_host : (host_id + 1) * per_host]
 
@@ -83,7 +85,12 @@ class ShardedLoader:
         drop_last: bool = False,
         host_id: Optional[int] = None,
         num_hosts: Optional[int] = None,
+        batcher=None,
     ):
+        # batcher: optional native batch assembler
+        # `(indices, epoch, batch_idx) -> (images, labels)` (see data/native.py);
+        # replaces the per-sample Python/PIL path when set
+        self.batcher = batcher
         self.dataset = dataset
         self.batch_size = batch_size
         self.shuffle = shuffle
@@ -108,13 +115,32 @@ class ShardedLoader:
     def __len__(self) -> int:
         return len(self._epoch_indices()) // self.batch_size
 
+    def valid_mask(self, batch_idx: int) -> np.ndarray:
+        """(batch_size,) 1.0 where the row is a real sample, 0.0 where it is
+        wrap-padding — exact-eval support (only meaningful for ordered,
+        shuffle=False loaders, where the padded tail duplicates the head)."""
+        assert not self.shuffle, "valid_mask is defined for ordered loaders"
+        import jax
+
+        host = jax.process_index() if self.host_id is None else self.host_id
+        per_host = len(self._epoch_indices())
+        start = host * per_host + batch_idx * self.batch_size
+        pos = start + np.arange(self.batch_size)
+        return (pos < len(self.dataset)).astype(np.float32)
+
     def _load_batch(self, batch_idx: int, indices: np.ndarray) -> Tuple[np.ndarray, np.ndarray]:
+        if self.batcher is not None:
+            return self.batcher(indices, self.epoch, batch_idx)
+
         def load(j_and_i):
             j, i = j_and_i
             rng = np.random.default_rng(
                 (self.seed, self.epoch, int(i), j)
             )
-            return self.dataset.__getitem__(int(i), rng)
+            item = self.dataset.__getitem__(int(i), rng)
+            # PLCDataset yields (image, label, index) (PLC/FolderDataset.py:56-75);
+            # the trailing index is positional bookkeeping we recover from `i`
+            return item[0], item[1]
 
         if self.num_workers > 1:
             with ThreadPoolExecutor(self.num_workers) as ex:
@@ -132,6 +158,18 @@ class ShardedLoader:
             return
         q: "queue.Queue" = queue.Queue(maxsize=self.prefetch)
         stop = threading.Event()
+        error: list = []
+
+        def put_or_stop(item) -> bool:
+            """Bounded put that gives up when the consumer abandoned us —
+            avoids deadlocking the producer on a full queue at teardown."""
+            while not stop.is_set():
+                try:
+                    q.put(item, timeout=0.1)
+                    return True
+                except queue.Full:
+                    continue
+            return False
 
         def producer():
             try:
@@ -139,9 +177,12 @@ class ShardedLoader:
                     if stop.is_set():
                         return
                     sl = indices[b * self.batch_size : (b + 1) * self.batch_size]
-                    q.put(self._load_batch(b, sl))
+                    if not put_or_stop(self._load_batch(b, sl)):
+                        return
+            except BaseException as e:  # re-raised in the consumer
+                error.append(e)
             finally:
-                q.put(None)
+                put_or_stop(None)
 
         t = threading.Thread(target=producer, daemon=True)
         t.start()
@@ -151,6 +192,10 @@ class ShardedLoader:
                 if item is None:
                     break
                 yield item
+            if error:
+                # a silent short epoch would corrupt training invisibly —
+                # surface the worker failure at the iteration site
+                raise error[0]
         finally:
             stop.set()
             # drain so the producer can exit

@@ -1,0 +1,154 @@
+"""ctypes binding + loader integration for the native C++ dataplane.
+
+The reference feeds GPUs with torch DataLoader worker *processes* running
+PIL/torchvision per sample (BASELINE/main.py:130-131). Here the host hot path
+is one C call per batch (`native/dataplane.cpp`): libjpeg decode →
+torchvision-semantics RandomResizedCrop / resize+center-crop → flip →
+normalize, fanned over a thread pool in native code (no GIL, no per-sample
+Python). Falls back to the pure-Python pipeline automatically when the
+library can't be built or a file isn't a JPEG.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import Optional, Tuple
+
+import numpy as np
+
+from .transforms import IMAGENET_MEAN, IMAGENET_STD
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+_SRC = os.path.join(_REPO_ROOT, "native", "dataplane.cpp")
+_LIB_DIR = os.path.join(_REPO_ROOT, "native", "build")
+_LIB = os.path.join(_LIB_DIR, "libdataplane.so")
+
+_lock = threading.Lock()
+_lib: Optional[ctypes.CDLL] = None
+_load_failed = False
+
+
+def _build() -> bool:
+    os.makedirs(_LIB_DIR, exist_ok=True)
+    cmd = [
+        "g++", "-O3", "-std=c++17", "-shared", "-fPIC",
+        "-o", _LIB, _SRC, "-ljpeg", "-lpthread",
+    ]
+    try:
+        subprocess.run(cmd, check=True, capture_output=True, timeout=120)
+        return True
+    except Exception:
+        return False
+
+
+def get_lib() -> Optional[ctypes.CDLL]:
+    """Load (building on first use) the native dataplane, or None."""
+    global _lib, _load_failed
+    with _lock:
+        if _lib is not None or _load_failed:
+            return _lib
+        if not os.path.exists(_LIB) or (
+            os.path.exists(_SRC) and os.path.getmtime(_SRC) > os.path.getmtime(_LIB)
+        ):
+            if not _build():
+                _load_failed = True
+                return None
+        try:
+            lib = ctypes.CDLL(_LIB)
+        except OSError:
+            _load_failed = True
+            return None
+        lib.dp_load_batch.restype = ctypes.c_int
+        lib.dp_load_batch.argtypes = [
+            ctypes.POINTER(ctypes.c_char_p), ctypes.c_int,
+            ctypes.POINTER(ctypes.c_float), ctypes.c_int, ctypes.c_int,
+            ctypes.c_int, ctypes.c_int, ctypes.c_double, ctypes.c_double,
+            ctypes.c_uint64, ctypes.POINTER(ctypes.c_float),
+            ctypes.POINTER(ctypes.c_float), ctypes.c_int,
+        ]
+        _lib = lib
+        return _lib
+
+
+_MEAN = (ctypes.c_float * 3)(*IMAGENET_MEAN)
+_STD = (ctypes.c_float * 3)(*IMAGENET_STD)
+
+
+def native_load_batch(
+    paths,
+    out_size: int,
+    train: bool,
+    resize_short: int = 256,
+    scale: Tuple[float, float] = (0.8, 1.0),
+    seed: int = 0,
+    num_threads: int = 4,
+) -> Optional[Tuple[np.ndarray, int]]:
+    """Decode+transform a list of JPEG paths into (B, S, S, 3) f32.
+
+    Returns (batch, n_failures) or None when the native library is
+    unavailable. Failure slots are zero-filled; the caller patches them via
+    the Python path.
+    """
+    lib = get_lib()
+    if lib is None:
+        return None
+    n = len(paths)
+    out = np.empty((n, out_size, out_size, 3), np.float32)
+    arr = (ctypes.c_char_p * n)(*[os.fsencode(p) for p in paths])
+    errors = lib.dp_load_batch(
+        arr, n, out.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+        out_size, out_size, int(train), resize_short,
+        float(scale[0]), float(scale[1]), ctypes.c_uint64(seed),
+        _MEAN, _STD, num_threads,
+    )
+    return out, int(errors)
+
+
+class NativeBatcher:
+    """Batch assembler for `ShardedLoader(batcher=...)` over a path-based
+    dataset (ImageFolderDataset). One native call per batch; slots the C side
+    could not decode (non-JPEG/corrupt) are re-loaded through the dataset's
+    PIL transform, so behavior is identical up to resampling details."""
+
+    # native path covers these presets (RRC+flip / resize+center-crop);
+    # 'cdr' (rotation) and 'cifar' (pad+crop on raw 32px) stay in Python
+    SUPPORTED = ("baseline", "clothing1m")
+
+    def __init__(self, dataset, preset: str, train: bool,
+                 image_size: int, crop_size: int, seed: int, num_threads: int = 4):
+        from .transforms import build_transform
+
+        self.dataset = dataset
+        self.train = train
+        self.seed = seed
+        self.num_threads = num_threads
+        self.resize_short = crop_size
+        # mirror build_transform's output-size quirk (train@crop_size for baseline)
+        t = build_transform(preset, train, image_size, crop_size)
+        self.out_size = t.out_size
+        self.scale = (0.08, 1.0) if preset == "clothing1m" else (0.8, 1.0)
+
+    @staticmethod
+    def available() -> bool:
+        return get_lib() is not None
+
+    def __call__(self, indices: np.ndarray, epoch: int, batch_idx: int):
+        paths = [self.dataset.paths[int(i)] for i in indices]
+        labels = np.asarray(
+            [self.dataset.labels[int(i)] for i in indices], np.int32)
+        seed = (self.seed * 1_000_003 + epoch * 10_007 + batch_idx) & 0xFFFFFFFF
+        res = native_load_batch(
+            paths, self.out_size, self.train, self.resize_short,
+            self.scale, seed, self.num_threads)
+        if res is None:
+            raise RuntimeError("native dataplane unavailable")
+        images, errors = res
+        if errors:
+            rng = np.random.default_rng(seed)
+            for j in np.nonzero(np.abs(images).sum(axis=(1, 2, 3)) == 0)[0]:
+                img, _ = self.dataset.__getitem__(int(indices[j]), rng)
+                images[j] = img
+        return images, labels

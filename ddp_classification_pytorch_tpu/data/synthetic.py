@@ -20,12 +20,18 @@ class SyntheticDataset:
     num_classes: int = 10
     seed: int = 0
     channels: int = 3
+    # offsets the per-item noise stream so train/val share class means (the
+    # learnable mapping) but draw disjoint samples
+    item_offset: int = 0
 
     def __post_init__(self) -> None:
-        rng = np.random.default_rng(self.seed)
-        self.labels = rng.integers(0, self.num_classes, size=self.size).astype(np.int32)
-        # per-class mean images make the task learnable (loss must drop in e2e tests)
-        self.class_means = rng.normal(0, 1, size=(self.num_classes, 1, 1, self.channels)).astype(np.float32)
+        # class means on a stream keyed by seed ONLY, so train/val datasets of
+        # different sizes share the same label→mean mapping (the learnable task)
+        means_rng = np.random.default_rng((self.seed, 0xC1A55))
+        self.class_means = means_rng.normal(
+            0, 1, size=(self.num_classes, 1, 1, self.channels)).astype(np.float32)
+        labels_rng = np.random.default_rng((self.seed, 0x1ABE15, self.item_offset))
+        self.labels = labels_rng.integers(0, self.num_classes, size=self.size).astype(np.int32)
 
     def __len__(self) -> int:
         return self.size
@@ -40,7 +46,7 @@ class SyntheticDataset:
 
     def __getitem__(self, i: int, rng: Optional[np.random.Generator] = None) -> Tuple[np.ndarray, int]:
         label = int(self.labels[i])
-        item_rng = np.random.default_rng(self.seed * 1_000_003 + i)
+        item_rng = np.random.default_rng(self.seed * 1_000_003 + self.item_offset + i)
         img = self.class_means[label] + 0.1 * item_rng.normal(
             size=(self.image_size, self.image_size, self.channels)
         ).astype(np.float32)

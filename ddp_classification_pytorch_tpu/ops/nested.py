@@ -19,7 +19,7 @@ one pass over the weight matrix and never materializes (K, B, C).
 
 from __future__ import annotations
 
-from typing import Tuple
+from typing import Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -60,21 +60,24 @@ def nested_all_k_counts(
     weight: jnp.ndarray,
     labels: jnp.ndarray,
     block: int = 128,
+    mask: Optional[jnp.ndarray] = None,
 ) -> Tuple[jnp.ndarray, jnp.ndarray]:
     """Per-K top-1 and top-3 correct counts for one batch, all K in one pass.
 
     Replaces the reference's per-K classifier loop (train.py:122-133) with a
     blocked cumulative matmul: scan over D/block feature blocks, carry the
     running logits (B, C), emit correct counts for the `block` K values inside
-    each block. Returns two (D,) count vectors.
+    each block. `mask` (B,) excludes padded rows. Returns two (D,) count
+    vectors.
     """
     b, d = features.shape
     c = weight.shape[0]
     assert d % block == 0, f"feat_dim {d} must be divisible by block {block}"
+    row_w = jnp.ones((b,), jnp.float32) if mask is None else mask.astype(jnp.float32)
     f32, w32 = features.astype(jnp.float32), weight.astype(jnp.float32)
     # (n_blocks, B, G) features and (n_blocks, G, C) weight slices
     f_blocks = jnp.moveaxis(f32.reshape(b, d // block, block), 1, 0)
-    w_blocks = jnp.moveaxis(w32.T.reshape(d // block, block, c), 0, 0)
+    w_blocks = w32.T.reshape(d // block, block, c)
 
     def step(carry_logits, blk):
         fb, wb = blk  # (B, G), (G, C)
@@ -87,8 +90,8 @@ def nested_all_k_counts(
             cum, labels[:, None, None].astype(jnp.int32), axis=2
         )  # (B, G, 1)
         rank = jnp.sum(cum > true_logit, axis=2)  # (B, G) number above true
-        top1 = jnp.sum(rank < 1, axis=0)  # (G,)
-        top3 = jnp.sum(rank < 3, axis=0)
+        top1 = jnp.sum((rank < 1) * row_w[:, None], axis=0)  # (G,)
+        top3 = jnp.sum((rank < 3) * row_w[:, None], axis=0)
         return cum[:, -1, :], (top1, top3)
 
     init = jnp.zeros((b, c), jnp.float32)

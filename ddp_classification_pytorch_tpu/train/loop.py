@@ -1,0 +1,225 @@
+"""The unified training loop — one Trainer for all five reference silos.
+
+Replaces the four near-duplicate epoch loops (`train_and_valid`
+BASELINE/main.py:258-317 and ARCFACE/arc_main.py:302-417, `train`+`evaluate`
+CDR/main.py:218-386, `Train`+`TestNested` NESTED/train.py:227-453) with one
+loop parameterized by the config tree. Shape of one epoch, matching the
+reference's observable behavior:
+
+    loader.set_epoch(e)              # sampler.set_epoch, BASELINE/main.py:269
+    for each batch: jitted train step (+ every-N console line with ETA, :284-303)
+    evaluate (exact cross-shard reduction; nested: vectorized all-K sweep)
+    record epoch line → output.txt / history.json   (:254-256; NESTED:444-445)
+    checkpoint (per-epoch and/or best-only; host-0 writes)
+
+TPU-first details the reference has no analogue for:
+- batches go host→device through `make_global_array` (per-host shard of a
+  global batch-sharded jax.Array) while the device runs the previous step —
+  jax's async dispatch gives the pin_memory/non_blocking overlap for free;
+- metrics come back as device scalars only when a log line is actually
+  printed (the reference syncs `.item()` every logged step);
+- LR schedule/warmup live inside the optimizer (schedule.py), so there is no
+  host-side `scheduler.step()` ordering bug (CDR/main.py:366 decays one epoch
+  early; documented divergence — we follow correct semantics).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import numpy as np
+
+from ..config import Config
+from ..data.loader import ShardedLoader
+from ..data.imagefolder import ImageFolderDataset
+from ..data.native import NativeBatcher
+from ..data.synthetic import SyntheticDataset
+from ..data.transforms import build_transform
+from ..ops.nested import best_k
+from ..parallel import mesh as meshlib
+from ..utils.logging import EtaLogger, RecordWriter, host0_print
+from .checkpoint import CheckpointManager
+from .state import create_train_state, param_count
+from .steps import make_eval_step, make_nested_eval_step, make_train_step
+
+
+def build_datasets(cfg: Config) -> Tuple[Any, Any]:
+    """(train_ds, val_ds) from DataConfig — the reference's per-silo dataset
+    blocks (BASELINE/main.py:124-125, CDR/main.py:296, NESTED/train.py:342)."""
+    d = cfg.data
+    if d.dataset == "synthetic":
+        size = d.synthetic_size or 512
+        train = SyntheticDataset(size, d.image_size, d.num_classes, seed=cfg.run.seed)
+        val = SyntheticDataset(max(size // 4, d.batch_size), d.image_size,
+                               d.num_classes, seed=cfg.run.seed, item_offset=size)
+        return train, val
+    if d.dataset == "imagefolder":
+        t_train = build_transform(d.transform, train=True, image_size=d.image_size,
+                                  crop_size=d.train_crop_size)
+        t_val = build_transform(d.transform, train=False, image_size=d.image_size,
+                                crop_size=d.train_crop_size)
+        train = ImageFolderDataset.from_root(
+            d.train_dir, t_train, d.imgs_per_class, d.max_classes)
+        val = ImageFolderDataset.from_root(
+            d.val_dir or d.train_dir, t_val, d.imgs_per_class, d.max_classes)
+        return train, val
+    if d.dataset == "plc":
+        # Clothing1M annotation layout (PLC/FolderDataset.py:9-75):
+        # train_dir/val_dir are the data roots; annotations live under
+        # <root>/annotations with key-list + label files per split
+        from ..data.plc import PLCDataset
+
+        t_train = build_transform("clothing1m", train=True, image_size=d.image_size,
+                                  crop_size=d.train_crop_size)
+        t_val = build_transform("clothing1m", train=False, image_size=d.image_size,
+                                crop_size=d.train_crop_size)
+        train = PLCDataset.from_annotations(d.train_dir, "train", t_train,
+                                            cls_size=d.imgs_per_class or 0)
+        val = PLCDataset.from_annotations(d.val_dir or d.train_dir, "val", t_val)
+        return train, val
+    raise ValueError(f"unknown dataset {d.dataset!r}")
+
+
+class Trainer:
+    def __init__(
+        self,
+        cfg: Config,
+        train_ds: Optional[Any] = None,
+        val_ds: Optional[Any] = None,
+        mesh: Optional[Any] = None,
+    ):
+        self.cfg = cfg
+        if train_ds is None:
+            train_ds, val_ds = build_datasets(cfg)
+        self.train_ds, self.val_ds = train_ds, val_ds
+
+        self.mesh = mesh if mesh is not None else meshlib.make_mesh(
+            meshlib.MeshSpec(cfg.parallel.data_axis, cfg.parallel.model_axis))
+
+        train_batcher = val_batcher = None
+        if (cfg.data.native_loader and cfg.data.dataset == "imagefolder"
+                and cfg.data.transform in NativeBatcher.SUPPORTED
+                and hasattr(train_ds, "paths") and NativeBatcher.available()):
+            mk = lambda ds, train: NativeBatcher(  # noqa: E731
+                ds, cfg.data.transform, train, cfg.data.image_size,
+                cfg.data.train_crop_size, cfg.run.seed, cfg.data.num_workers)
+            train_batcher, val_batcher = mk(train_ds, True), mk(val_ds, False)
+            host0_print("[trainer] native C++ dataplane active")
+
+        self.train_loader = ShardedLoader(
+            train_ds, cfg.data.batch_size, shuffle=True, seed=cfg.run.seed,
+            num_workers=cfg.data.num_workers, prefetch=cfg.data.prefetch,
+            batcher=train_batcher)
+        self.val_loader = ShardedLoader(
+            val_ds, cfg.data.batch_size, shuffle=False, seed=cfg.run.seed,
+            num_workers=cfg.data.num_workers, prefetch=cfg.data.prefetch,
+            batcher=val_batcher)
+
+        self.steps_per_epoch = max(len(self.train_loader), 1)
+        self.model, self.tx, self.state = create_train_state(
+            cfg, self.mesh, self.steps_per_epoch)
+
+        self.train_step = make_train_step(cfg, self.model, self.tx)
+        self.eval_step = make_eval_step(cfg, self.model)
+        self.nested_eval_step = (
+            make_nested_eval_step(cfg, self.model)
+            if cfg.model.head == "nested" else None
+        )
+
+        self.records = RecordWriter(cfg.run.out_dir) if cfg.run.write_records else None
+        self.ckpt = CheckpointManager(
+            cfg.run.out_dir,
+            save_every_epoch=cfg.run.save_every_epoch,
+            best_only=cfg.run.save_best_only,
+        )
+        self.start_epoch = 0
+        if cfg.run.resume:
+            self.state = self.ckpt.restore(self.state, cfg.run.resume)
+            # meta lives next to the checkpoint being resumed (which may be a
+            # previous run's out_dir, not this one's)
+            meta = CheckpointManager.meta_for_checkpoint(cfg.run.resume)
+            self.start_epoch = int(meta.get("last_epoch", -1)) + 1
+            host0_print(f"resumed from {cfg.run.resume} at epoch {self.start_epoch}")
+
+        host0_print(
+            f"[trainer] workload={cfg.workload} arch={cfg.model.arch} "
+            f"params={param_count(self.state):,} devices={len(jax.devices())} "
+            f"mesh={dict(zip(self.mesh.axis_names, self.mesh.devices.shape))} "
+            f"steps/epoch={self.steps_per_epoch}"
+        )
+
+    # ---------------------------------------------------------------- train --
+    def train_epoch(self, epoch: int, eta: Optional[EtaLogger] = None) -> Dict[str, float]:
+        self.train_loader.set_epoch(epoch)
+        sums = None  # device-side accumulation: no per-step host sync, so the
+        n_batches = 0  # host keeps dispatching ahead of the device
+        for step, (images, labels) in enumerate(self.train_loader):
+            batch = meshlib.make_global_array((images, labels), self.mesh)
+            self.state, metrics = self.train_step(self.state, *batch)
+            n_batches += 1
+            sums = metrics if sums is None else jax.tree_util.tree_map(
+                jax.numpy.add, sums, metrics)
+            if eta is not None and step % self.cfg.run.log_every == 0:
+                # the only host sync per log_every steps (reference syncs
+                # .item() on the same cadence, BASELINE/main.py:284-303)
+                eta.maybe_log(epoch, step, **{k: float(v) for k, v in metrics.items()})
+        if sums is None:
+            return {"loss": 0.0, "top1": 0.0, "top3": 0.0}
+        return {k: float(v) / n_batches for k, v in sums.items()}
+
+    # ----------------------------------------------------------------- eval --
+    def evaluate(self) -> Dict[str, float]:
+        if self.nested_eval_step is not None:
+            return self._evaluate_nested()
+        totals = {"loss_sum": 0.0, "top1": 0.0, "top3": 0.0, "n": 0.0}
+        for b_idx, (images, labels) in enumerate(self.val_loader):
+            valid = self.val_loader.valid_mask(b_idx)
+            batch = meshlib.make_global_array((images, labels, valid), self.mesh)
+            out = self.eval_step(self.state, *batch)
+            for k in totals:
+                totals[k] += float(out[k])
+        n = max(totals["n"], 1.0)
+        return {
+            "val_loss": totals["loss_sum"] / n,
+            "val_top1": totals["top1"] / n,
+            "val_top3": totals["top3"] / n,
+        }
+
+    def _evaluate_nested(self) -> Dict[str, float]:
+        t1 = t3 = None
+        n = 0.0
+        for b_idx, (images, labels) in enumerate(self.val_loader):
+            valid = self.val_loader.valid_mask(b_idx)
+            batch = meshlib.make_global_array((images, labels, valid), self.mesh)
+            out = self.nested_eval_step(self.state, *batch)
+            t1 = out["top1_k"] if t1 is None else t1 + out["top1_k"]
+            t3 = out["top3_k"] if t3 is None else t3 + out["top3_k"]
+            n += float(out["n"])
+        acc, k = best_k(t1, np.float32(max(n, 1.0)))
+        return {
+            "val_top1": float(acc),
+            "val_top3": float(t3[int(k)] / max(n, 1.0)),
+            "best_k": int(k),
+        }
+
+    # ------------------------------------------------------------------ run --
+    def run(self) -> Dict[str, float]:
+        cfg = self.cfg
+        eta = EtaLogger(self.steps_per_epoch, cfg.run.epochs, cfg.run.log_every)
+        last: Dict[str, float] = {}
+        for epoch in range(self.start_epoch, cfg.run.epochs):
+            t0 = time.time()
+            train_m = self.train_epoch(epoch, eta)
+            val_m = self.evaluate() if (epoch + 1) % cfg.run.eval_every == 0 else {}
+            last = {**train_m, **val_m, "epoch_time": time.time() - t0}
+            host0_print(
+                f"[epoch {epoch}] " + " ".join(f"{k}={v:.4f}" for k, v in last.items())
+            )
+            if self.records is not None:
+                self.records.log_epoch(epoch, **{k: v for k, v in last.items()})
+            metric = val_m.get("val_top1")
+            self.ckpt.save(self.state, epoch, metric=metric,
+                           **({"best_k": val_m["best_k"]} if "best_k" in val_m else {}))
+        return last

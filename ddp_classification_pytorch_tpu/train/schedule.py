@@ -19,6 +19,8 @@ from __future__ import annotations
 
 from typing import Callable, Optional
 
+import jax
+import jax.numpy as jnp
 import optax
 
 from ..config import OptimConfig
@@ -43,8 +45,17 @@ def build_schedule(cfg: OptimConfig, steps_per_epoch: int) -> optax.Schedule:
         raise ValueError(f"unknown schedule {cfg.schedule!r}")
 
     if cfg.warmup_iters > 0:
+        # The reference ramps lr per-iteration while the epoch-indexed decay
+        # schedule keeps counting from epoch 0 (NESTED/train.py:292-295 with
+        # MultiStepLR stepping per epoch at :447-448). optax.join_schedules
+        # would shift `main` by warmup_iters — so overlay instead: decay
+        # milestones stay anchored at the true global step.
         warm = optax.linear_schedule(cfg.warmup_start_lr, cfg.lr, cfg.warmup_iters)
-        return optax.join_schedules([warm, main], [cfg.warmup_iters])
+
+        def overlaid(step):
+            return jnp.where(step < cfg.warmup_iters, warm(step), main(step))
+
+        return overlaid
     return main
 
 
@@ -79,9 +90,7 @@ def build_optimizer(
         parts.append(
             optax.masked(
                 optax.set_to_zero(),
-                lambda params: __import__("jax").tree_util.tree_map_with_path(
-                    _is_bn_param, params
-                ),
+                lambda params: jax.tree_util.tree_map_with_path(_is_bn_param, params),
             )
         )
     return optax.with_extra_args_support(optax.chain(*parts))

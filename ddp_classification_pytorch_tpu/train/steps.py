@@ -1,0 +1,192 @@
+"""Jitted train/eval step builders for every workload.
+
+Each builder closes over the static pieces (model, optimizer, workload
+algorithm) and returns ONE jitted function. Under jit with a batch-sharded
+global array, XLA inserts every collective the reference performs explicitly:
+
+- DDP's bucketed gradient allreduce (BASELINE/main.py:149, backward hooks) is
+  implicit in the mean-over-global-batch loss;
+- SyncBatchNorm's stat reduction (BASELINE/main.py:148) is implicit in
+  BatchNorm's mean over the sharded batch axis;
+- the eval `dist.reduce` the reference *approximates away*
+  (BASELINE/main.py:247-249 scales one rank's counts by world_size) is an
+  exact cross-shard sum here, for free.
+
+Train steps donate the state buffer (in-place device update). Metrics are
+computed in-jit from the same logits used for the loss — the reference pays a
+separate `.item()` device→host sync per log line (BASELINE/main.py:284-303).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from ..config import Config
+from ..models.factory import feat_dim_for
+from ..ops.nested import (
+    gaussian_dist,
+    nested_all_k_counts,
+    prefix_mask,
+    sample_mask_dims,
+)
+from ..utils.metrics import topk_correct
+from .state import TrainState
+
+Batch = Tuple[jnp.ndarray, jnp.ndarray]  # (images NHWC f32, labels i32)
+
+
+def _cross_entropy(logits: jnp.ndarray, labels: jnp.ndarray) -> jnp.ndarray:
+    """Mean softmax-CE — semantics of the reference's LogSoftmax+NLLLoss pair
+    (BASELINE/main.py:139,152) in one fused, stable op."""
+    return optax.softmax_cross_entropy_with_integer_labels(
+        logits.astype(jnp.float32), labels
+    ).mean()
+
+
+def _train_metrics(loss, logits, labels) -> Dict[str, jnp.ndarray]:
+    n = labels.shape[0]
+    return {
+        "loss": loss,
+        "top1": topk_correct(logits, labels, 1) / n,
+        "top3": topk_correct(logits, labels, 3) / n,
+    }
+
+
+def make_train_step(
+    cfg: Config,
+    model: Any,
+    tx: optax.GradientTransformationExtraArgs,
+    base_rng: Optional[jax.Array] = None,
+) -> Callable[[TrainState, jnp.ndarray, jnp.ndarray], Tuple[TrainState, Dict[str, jnp.ndarray]]]:
+    """One jitted `(state, images, labels) -> (state, metrics)` for the
+    workload in `cfg` (baseline/cdr: plain CE; arcface: margin logits;
+    nested: per-batch prefix mask k ~ Gaussian, NESTED/train.py:247-250)."""
+    workload = cfg.model.head
+    if base_rng is None:
+        base_rng = jax.random.PRNGKey(cfg.run.seed + 1)
+    if workload == "nested":
+        dist = jnp.asarray(gaussian_dist(0.0, cfg.model.nested_std, feat_dim_for(cfg.model)))
+        feat_dim = feat_dim_for(cfg.model)
+
+    def loss_fn(params, batch_stats, images, labels, rng):
+        variables = {"params": params, "batch_stats": batch_stats}
+        mask_rng, drop_rng = jax.random.split(rng)
+        kwargs = dict(train=True, mutable=["batch_stats"], rngs={"dropout": drop_rng})
+        if workload == "arcface":
+            logits, mutated = model.apply(variables, images, labels, **kwargs)
+        elif workload == "nested":
+            k = sample_mask_dims(mask_rng, dist)          # one k per batch (:248)
+            mask = prefix_mask(k, feat_dim)
+            logits, mutated = model.apply(variables, images, mask, **kwargs)
+        else:
+            logits, mutated = model.apply(variables, images, **kwargs)
+        loss = _cross_entropy(logits, labels)
+        return loss, (mutated.get("batch_stats", batch_stats), logits)
+
+    def step(state: TrainState, images: jnp.ndarray, labels: jnp.ndarray):
+        rng = jax.random.fold_in(base_rng, state.step)
+        (loss, (new_stats, logits)), grads = jax.value_and_grad(loss_fn, has_aux=True)(
+            state.params, state.batch_stats, images, labels, rng
+        )
+        updates, new_opt = tx.update(grads, state.opt_state, state.params)
+        new_params = optax.apply_updates(state.params, updates)
+        new_state = state.replace(
+            step=state.step + 1,
+            params=new_params,
+            batch_stats=new_stats,
+            opt_state=new_opt,
+        )
+        return new_state, _train_metrics(loss, logits, labels)
+
+    return jax.jit(step, donate_argnums=0)
+
+
+def _topk_hits(logits: jnp.ndarray, labels: jnp.ndarray, k: int) -> jnp.ndarray:
+    top = jnp.argsort(-logits, axis=-1)[..., :k]
+    return (top == labels[..., None]).any(axis=-1)
+
+
+def make_eval_step(
+    cfg: Config, model: Any
+) -> Callable[..., Dict[str, jnp.ndarray]]:
+    """`(state, images, labels, valid) -> {loss_sum, top1, top3, n}` —
+    per-batch COUNTS over the rows where valid==1, summed exactly on host
+    across batches. This replaces the reference's per-rank-shard metric
+    scaled by world_size (BASELINE/main.py:247-249) with the exact global
+    reduction; `valid` additionally masks the loader's wrap-padding so the
+    metrics are exact for any val-set size."""
+    workload = cfg.model.head
+
+    def step(state: TrainState, images: jnp.ndarray, labels: jnp.ndarray,
+             valid: jnp.ndarray):
+        variables = {"params": state.params, "batch_stats": state.batch_stats}
+        if workload in ("arcface", "nested"):
+            # arcface inference scores are s·cosθ (no margin), arc_main.py eval
+            logits = model.apply(variables, images, None, train=False)
+        else:
+            logits = model.apply(variables, images, train=False)
+        ce = optax.softmax_cross_entropy_with_integer_labels(
+            logits.astype(jnp.float32), labels)
+        return {
+            "loss_sum": (ce * valid).sum(),
+            "top1": (_topk_hits(logits, labels, 1) * valid).sum(),
+            "top3": (_topk_hits(logits, labels, 3) * valid).sum(),
+            "n": valid.sum(),
+        }
+
+    return jax.jit(step)
+
+
+def make_predict_step(
+    cfg: Config, model: Any, batch_stat_mode: bool = False
+) -> Callable[[TrainState, jnp.ndarray], jnp.ndarray]:
+    """`(state, images) -> (B, C) logits` — used by the PLC correction loop
+    to collect f(x) over the train set.
+
+    batch_stat_mode=True normalizes with the prediction batch's own BN
+    statistics (discarding the mutation) instead of the running averages —
+    matching the reference's practice of harvesting softmax outputs during
+    training (PLC/utils.py:269-271), and robust when running stats are still
+    converging early in training."""
+    workload = cfg.model.head
+
+    def step(state: TrainState, images: jnp.ndarray) -> jnp.ndarray:
+        variables = {"params": state.params, "batch_stats": state.batch_stats}
+        args = (images, None) if workload in ("arcface", "nested") else (images,)
+        if batch_stat_mode:
+            logits, _ = model.apply(
+                variables, *args, train=True, mutable=["batch_stats"],
+                rngs={"dropout": jax.random.PRNGKey(0)},
+            )
+            return logits
+        return model.apply(variables, *args, train=False)
+
+    return jax.jit(step)
+
+
+def make_nested_eval_step(
+    cfg: Config, model: Any
+) -> Callable[[TrainState, jnp.ndarray, jnp.ndarray], Dict[str, jnp.ndarray]]:
+    """All-K truncation sweep for one batch → per-K correct counts (D,).
+
+    The reference runs D separate classifier forwards per batch
+    (NESTED/train.py:122-124); here the whole sweep is one blocked cumulative
+    matmul on the MXU (ops/nested.py). Counts are summed across batches on
+    host; `ops.nested.best_k` then applies the 1e-5·K tiebreak (:143)."""
+    feat_dim = feat_dim_for(cfg.model)
+    block = 128 if feat_dim % 128 == 0 else feat_dim
+
+    def step(state: TrainState, images: jnp.ndarray, labels: jnp.ndarray,
+             valid: jnp.ndarray):
+        variables = {"params": state.params, "batch_stats": state.batch_stats}
+        feats = model.apply(variables, images, train=False, method="features")
+        # NetClassifier kernel is (D, C); the sweep wants (C, D)
+        weight = state.params["classifier"]["fc"]["kernel"].T
+        t1, t3 = nested_all_k_counts(feats, weight, labels, block=block, mask=valid)
+        return {"top1_k": t1, "top3_k": t3, "n": valid.sum()}
+
+    return jax.jit(step)

@@ -1,0 +1,258 @@
+// Native host dataplane: JPEG decode → crop/resize → flip → normalize,
+// multithreaded, one call per batch.
+//
+// This is the TPU framework's native-code replacement for the reference's
+// input pipeline hot path — `DataLoader(num_workers=4, pin_memory=True)`
+// worker processes running PIL + torchvision transforms per sample
+// (reference BASELINE/main.py:58-76,130-131). One C call fills a whole
+// NHWC float32 batch buffer that jax can ship to device without further
+// host-side work. Decoding uses libjpeg directly; crops follow torchvision
+// semantics (RandomResizedCrop(scale, ratio 3/4..4/3, 10 tries, fallback
+// center; val: resize-short-side + center crop) so training recipes match
+// the reference's augmentation distribution.
+//
+// Build: g++ -O3 -march=native -shared -fPIC -o libdataplane.so dataplane.cpp -ljpeg -lpthread
+
+#include <algorithm>
+#include <atomic>
+#include <cmath>
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <thread>
+#include <vector>
+
+#include <jpeglib.h>
+#include <csetjmp>
+
+namespace {
+
+// --------------------------------------------------------------- RNG -------
+// SplitMix64 → xoshiro-like per-item stream; deterministic given (seed, item).
+struct Rng {
+  uint64_t s;
+  explicit Rng(uint64_t seed) : s(seed) {}
+  uint64_t next_u64() {
+    s += 0x9E3779B97f4A7C15ULL;
+    uint64_t z = s;
+    z = (z ^ (z >> 30)) * 0xBF58476D1CE4E5B9ULL;
+    z = (z ^ (z >> 27)) * 0x94D049BB133111EBULL;
+    return z ^ (z >> 31);
+  }
+  double uniform() {  // [0, 1)
+    return (next_u64() >> 11) * (1.0 / 9007199254740992.0);
+  }
+  double uniform(double lo, double hi) { return lo + (hi - lo) * uniform(); }
+  int randint(int n) { return (int)(uniform() * n); }  // [0, n)
+};
+
+// ------------------------------------------------------------- decode ------
+struct JpegErr {
+  jpeg_error_mgr mgr;
+  jmp_buf jb;
+};
+
+void jpeg_err_exit(j_common_ptr cinfo) {
+  JpegErr* err = reinterpret_cast<JpegErr*>(cinfo->err);
+  longjmp(err->jb, 1);
+}
+
+// Decode a JPEG file to RGB u8. Returns true on success.
+bool decode_jpeg(const char* path, std::vector<uint8_t>& out, int& w, int& h) {
+  FILE* f = fopen(path, "rb");
+  if (!f) return false;
+  jpeg_decompress_struct cinfo;
+  JpegErr jerr;
+  cinfo.err = jpeg_std_error(&jerr.mgr);
+  jerr.mgr.error_exit = jpeg_err_exit;
+  if (setjmp(jerr.jb)) {
+    jpeg_destroy_decompress(&cinfo);
+    fclose(f);
+    return false;
+  }
+  jpeg_create_decompress(&cinfo);
+  jpeg_stdio_src(&cinfo, f);
+  jpeg_read_header(&cinfo, TRUE);
+  cinfo.out_color_space = JCS_RGB;
+  jpeg_start_decompress(&cinfo);
+  w = cinfo.output_width;
+  h = cinfo.output_height;
+  out.resize((size_t)w * h * 3);
+  while (cinfo.output_scanline < cinfo.output_height) {
+    uint8_t* row = out.data() + (size_t)cinfo.output_scanline * w * 3;
+    jpeg_read_scanlines(&cinfo, &row, 1);
+  }
+  jpeg_finish_decompress(&cinfo);
+  jpeg_destroy_decompress(&cinfo);
+  fclose(f);
+  return true;
+}
+
+// ------------------------------------------------------------ resample -----
+// Bilinear sample from src (h×w RGB u8) region [y0,y0+ch)×[x0,x0+cw)
+// scaled to out_h×out_w, optional horizontal flip, normalized to f32 CHW-less
+// NHWC with (v/255 - mean)/std.
+void crop_resize_normalize(const uint8_t* src, int w, int h,
+                           double x0, double y0, double cw, double ch,
+                           float* dst, int out_w, int out_h, bool flip,
+                           const float* mean, const float* stdv) {
+  const double sx = cw / out_w, sy = ch / out_h;
+  for (int oy = 0; oy < out_h; ++oy) {
+    // torchvision/PIL bilinear: sample at pixel centers
+    double fy = y0 + (oy + 0.5) * sy - 0.5;
+    int y_lo = (int)std::floor(fy);
+    double wy = fy - y_lo;
+    int y0c = std::clamp(y_lo, 0, h - 1);
+    int y1c = std::clamp(y_lo + 1, 0, h - 1);
+    for (int ox = 0; ox < out_w; ++ox) {
+      double fx = x0 + (ox + 0.5) * sx - 0.5;
+      int x_lo = (int)std::floor(fx);
+      double wx = fx - x_lo;
+      int x0c = std::clamp(x_lo, 0, w - 1);
+      int x1c = std::clamp(x_lo + 1, 0, w - 1);
+      const uint8_t* p00 = src + ((size_t)y0c * w + x0c) * 3;
+      const uint8_t* p01 = src + ((size_t)y0c * w + x1c) * 3;
+      const uint8_t* p10 = src + ((size_t)y1c * w + x0c) * 3;
+      const uint8_t* p11 = src + ((size_t)y1c * w + x1c) * 3;
+      int out_x = flip ? (out_w - 1 - ox) : ox;
+      float* q = dst + ((size_t)oy * out_w + out_x) * 3;
+      for (int c = 0; c < 3; ++c) {
+        double v = (1 - wy) * ((1 - wx) * p00[c] + wx * p01[c]) +
+                   wy * ((1 - wx) * p10[c] + wx * p11[c]);
+        q[c] = ((float)(v / 255.0) - mean[c]) / stdv[c];
+      }
+    }
+  }
+}
+
+// torchvision RandomResizedCrop box: sample area∈scale·A, ratio∈(3/4,4/3),
+// 10 attempts, else center fallback.
+void rrc_box(Rng& rng, int w, int h, double smin, double smax,
+             double& x0, double& y0, double& cw, double& ch) {
+  const double area = (double)w * h;
+  const double log_rmin = std::log(3.0 / 4.0), log_rmax = std::log(4.0 / 3.0);
+  for (int i = 0; i < 10; ++i) {
+    double target = area * rng.uniform(smin, smax);
+    double ratio = std::exp(rng.uniform(log_rmin, log_rmax));
+    int tw = (int)std::lround(std::sqrt(target * ratio));
+    int th = (int)std::lround(std::sqrt(target / ratio));
+    if (tw > 0 && th > 0 && tw <= w && th <= h) {
+      x0 = rng.randint(w - tw + 1);
+      y0 = rng.randint(h - th + 1);
+      cw = tw;
+      ch = th;
+      return;
+    }
+  }
+  // fallback: clamp ratio, center crop (torchvision semantics)
+  double in_ratio = (double)w / h;
+  if (in_ratio < 3.0 / 4.0) {
+    cw = w;
+    ch = std::round(cw / (3.0 / 4.0));
+  } else if (in_ratio > 4.0 / 3.0) {
+    ch = h;
+    cw = std::round(ch * (4.0 / 3.0));
+  } else {
+    cw = w;
+    ch = h;
+  }
+  x0 = (w - cw) / 2.0;
+  y0 = (h - ch) / 2.0;
+}
+
+struct BatchJob {
+  const char** paths;
+  int n;
+  float* out;
+  int out_h, out_w;
+  int train;
+  int resize_short;
+  double scale_min, scale_max;
+  uint64_t seed;
+  const float* mean;
+  const float* stdv;
+  std::atomic<int> next{0};
+  std::atomic<int> errors{0};
+};
+
+void worker(BatchJob* job) {
+  std::vector<uint8_t> buf;
+  int w, h;
+  for (;;) {
+    int i = job->next.fetch_add(1);
+    if (i >= job->n) return;
+    float* dst = job->out + (size_t)i * job->out_h * job->out_w * 3;
+    if (!decode_jpeg(job->paths[i], buf, w, h)) {
+      // unreadable/non-JPEG: zero-fill; caller may retry via the Python path
+      std::memset(dst, 0, sizeof(float) * job->out_h * job->out_w * 3);
+      job->errors.fetch_add(1);
+      continue;
+    }
+    Rng rng(job->seed * 0x9E3779B97f4A7C15ULL + (uint64_t)i * 0xD1B54A32D192ED03ULL);
+    double x0, y0, cw, ch;
+    bool flip = false;
+    if (job->train) {
+      rrc_box(rng, w, h, job->scale_min, job->scale_max, x0, y0, cw, ch);
+      flip = rng.uniform() < 0.5;
+    } else {
+      // Resize(resize_short) + CenterCrop(out): equivalent single resample —
+      // crop box side = out/resize_short · short_side, centered
+      double scale = (double)std::min(w, h) / job->resize_short;
+      cw = job->out_w * scale;
+      ch = job->out_h * scale;
+      x0 = (w - cw) / 2.0;
+      y0 = (h - ch) / 2.0;
+    }
+    crop_resize_normalize(buf.data(), w, h, x0, y0, cw, ch, dst,
+                          job->out_w, job->out_h, flip, job->mean, job->stdv);
+  }
+}
+
+}  // namespace
+
+extern "C" {
+
+// Fill out[n, out_h, out_w, 3] float32. Returns number of decode failures
+// (their slots are zero-filled; indices of failures are not reported — the
+// Python wrapper re-loads failed slots through PIL when the count is >0).
+int dp_load_batch(const char** paths, int n, float* out, int out_h, int out_w,
+                  int train, int resize_short, double scale_min,
+                  double scale_max, uint64_t seed, const float* mean,
+                  const float* stdv, int num_threads) {
+  BatchJob job;
+  job.paths = paths;
+  job.n = n;
+  job.out = out;
+  job.out_h = out_h;
+  job.out_w = out_w;
+  job.train = train;
+  job.resize_short = resize_short;
+  job.scale_min = scale_min;
+  job.scale_max = scale_max;
+  job.seed = seed;
+  job.mean = mean;
+  job.stdv = stdv;
+  int t = std::max(1, std::min(num_threads, n));
+  if (t == 1) {
+    worker(&job);
+  } else {
+    std::vector<std::thread> threads;
+    threads.reserve(t);
+    for (int i = 0; i < t; ++i) threads.emplace_back(worker, &job);
+    for (auto& th : threads) th.join();
+  }
+  return job.errors.load();
+}
+
+// Decode a single JPEG into out (caller-allocated w*h*3 after probing).
+// Probe: returns 0 on success and writes w/h; -1 on failure.
+int dp_probe_jpeg(const char* path, int* w, int* h) {
+  std::vector<uint8_t> buf;
+  int ww, hh;
+  if (!decode_jpeg(path, buf, ww, hh)) return -1;
+  *w = ww;
+  *h = hh;
+  return 0;
+}
+
+}  // extern "C"

@@ -1,0 +1,82 @@
+"""ImageFolder → native dataplane → sharded train step, end to end.
+
+Builds a real class-directory tree of JPEGs (the reference's data layout,
+BASELINE/main.py:97-121), and trains one epoch with the native C++ loader
+active, verifying the whole path produces finite metrics and the native
+batcher is actually engaged.
+"""
+
+import numpy as np
+import pytest
+from PIL import Image
+
+from ddp_classification_pytorch_tpu.config import get_preset
+from ddp_classification_pytorch_tpu.train.loop import Trainer
+
+
+@pytest.fixture(scope="module")
+def image_tree(tmp_path_factory):
+    root = tmp_path_factory.mktemp("dataset")
+    rng = np.random.default_rng(0)
+    means = rng.integers(40, 215, size=(3, 3))
+    for split in ("train", "val"):
+        for c in range(3):
+            d = root / split / f"class{c}"
+            d.mkdir(parents=True)
+            for i in range(8 if split == "train" else 4):
+                img = np.clip(
+                    means[c] + rng.normal(0, 25, (48, 48, 3)), 0, 255
+                ).astype(np.uint8)
+                Image.fromarray(img).save(d / f"{i}.jpg", quality=92)
+    return root
+
+
+def test_imagefolder_native_train(image_tree, tmp_path):
+    cfg = get_preset("baseline")
+    cfg.data.dataset = "imagefolder"
+    cfg.data.train_dir = str(image_tree / "train")
+    cfg.data.val_dir = str(image_tree / "val")
+    cfg.data.num_classes = 3
+    cfg.data.batch_size = 8
+    cfg.data.image_size = 32
+    cfg.data.train_crop_size = 40
+    cfg.data.num_workers = 2
+    cfg.model.arch = "resnet18"
+    cfg.model.variant = "cifar"
+    cfg.model.dtype = "float32"
+    cfg.run.epochs = 1
+    cfg.run.out_dir = str(tmp_path)
+    cfg.run.write_records = False
+    cfg.run.save_every_epoch = False
+
+    tr = Trainer(cfg)
+    assert tr.train_loader.batcher is not None, "native dataplane not engaged"
+    m = tr.train_epoch(0)
+    assert np.isfinite(m["loss"])
+    val = tr.evaluate()
+    assert 0.0 <= val["val_top1"] <= 1.0
+
+
+def test_imagefolder_python_fallback(image_tree, tmp_path):
+    cfg = get_preset("baseline")
+    cfg.data.dataset = "imagefolder"
+    cfg.data.train_dir = str(image_tree / "train")
+    cfg.data.val_dir = str(image_tree / "val")
+    cfg.data.native_loader = False
+    cfg.data.num_classes = 3
+    cfg.data.batch_size = 8
+    cfg.data.image_size = 32
+    cfg.data.train_crop_size = 40
+    cfg.data.num_workers = 2
+    cfg.model.arch = "resnet18"
+    cfg.model.variant = "cifar"
+    cfg.model.dtype = "float32"
+    cfg.run.epochs = 1
+    cfg.run.out_dir = str(tmp_path)
+    cfg.run.write_records = False
+    cfg.run.save_every_epoch = False
+
+    tr = Trainer(cfg)
+    assert tr.train_loader.batcher is None
+    m = tr.train_epoch(0)
+    assert np.isfinite(m["loss"])

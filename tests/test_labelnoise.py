@@ -1,0 +1,108 @@
+"""PLC noisy-label toolkit tests (reference semantics: PLC/utils.py:149-360)."""
+
+import numpy as np
+
+from ddp_classification_pytorch_tpu.ops.labelnoise import (
+    eta_approximation,
+    label_noise,
+    lrt_correction,
+    prob_correction,
+)
+
+
+def _eta_for(labels, num_classes, confidence, rng):
+    """Synthetic posterior: extra `confidence` mass on the true class."""
+    n = len(labels)
+    eta = rng.random((n, num_classes)) * 0.3
+    eta[np.arange(n), labels] += confidence
+    return eta / eta.sum(1, keepdims=True)
+
+
+def test_label_noise_binary_flips_only_ones():
+    rng = np.random.default_rng(0)
+    labels = rng.integers(0, 2, 1000)
+    eta = _eta_for(labels, 2, 3.0, rng)
+    for t in (0, 1, 2):
+        noisy, f_us, count = label_noise(labels, eta, t, rng=np.random.default_rng(t))
+        # class-0 samples never change (reference :163: only y==1 redrawn)
+        assert (noisy[labels == 0] == 0).all()
+        assert f_us.shape == (1000,)
+        assert count == int(((labels == 1) & (noisy == 0)).sum())
+
+
+def test_label_noise_multiclass_targets_top2():
+    rng = np.random.default_rng(1)
+    labels = rng.integers(0, 10, 2000)
+    eta = _eta_for(labels, 10, 2.0, rng)
+    order = np.argsort(-eta, axis=1)
+    u, s = order[:, 0], order[:, 1]
+    for t in (0, 1, 2):
+        noisy, f_us, count = label_noise(labels, eta, t, rng=np.random.default_rng(t))
+        # every resampled label is one of the top-2 η classes (reference :186)
+        assert ((noisy == u) | (noisy == s)).all()
+        assert count == int((noisy != labels).sum())
+        assert 0 < count < len(labels)  # some noise, not total
+
+
+def test_label_noise_type0_noise_floor():
+    # type 0 noise_level = max(1-f, 0.5): even a perfectly confident η keeps
+    # ≥ (0.5/factor) chance of flipping to u (which IS the true class when η
+    # is centered on it) — so with η == one-hot, noisy labels stay u or s
+    rng = np.random.default_rng(2)
+    labels = rng.integers(0, 5, 500)
+    eta = np.eye(5)[labels] * 0.9 + 0.02
+    noisy, _, _ = label_noise(labels, eta, 0, rng=rng)
+    u = np.argmax(eta, 1)
+    assert ((noisy == u) | (noisy != u)).all()  # sanity: no out-of-range labels
+    assert noisy.min() >= 0 and noisy.max() < 5
+
+
+def test_lrt_correction_flips_low_ratio():
+    # 4 samples, 3 classes; f_x rows: prob-like scores
+    f_x = np.array([
+        [0.9, 0.05, 0.05],   # y=1 -> LR 0.055 < 0.3 -> flip to 0
+        [0.4, 0.5, 0.1],     # y=1 -> LR 1.0 -> keep
+        [0.2, 0.3, 0.5],     # y=2 -> LR 1.0 -> keep
+        [0.3, 0.35, 0.35],   # y=0 -> LR 0.857 -> keep
+    ])
+    y = np.array([1, 1, 2, 0])
+    out, delta = lrt_correction(y, f_x, current_delta=0.3, delta_increment=0.1)
+    assert out.tolist() == [0, 1, 2, 0]
+    assert delta == 0.3  # 1 correction ≥ 0.001·4 -> threshold unchanged
+
+    # no corrections -> delta grows, capped at 0.9
+    y2 = np.array([0, 1, 2, 1])
+    out2, d2 = lrt_correction(y2, f_x, current_delta=0.85, delta_increment=0.1)
+    assert d2 == 0.9
+
+
+def test_prob_correction_reference_k1():
+    logits = np.array([
+        [5.0, 0.0, 0.0],   # confident; y=1 ratio << delta -> flip to 0
+        [0.1, 0.0, 0.0],   # low-confidence if thd high -> argmax flip (k=1)
+    ])
+    y = np.array([1, 2])
+    out, delta = prob_correction(y, logits, current_delta=0.3, thd=0.99)
+    # row0: top prob ~0.97 < .99 -> low-conf branch -> argmax 0
+    # row1: low-conf -> argmax 0
+    assert out.tolist() == [0, 0]
+    assert delta == 0.4  # no LRT corrections -> delta += increment (uncapped)
+
+    out2, d2 = prob_correction(np.array([1, 2]), logits, current_delta=0.3, thd=0.5)
+    assert out2[0] == 0  # confident LRT flip
+    assert d2 == 0.3
+
+
+def test_eta_approximation_learns_separable_features():
+    rng = np.random.default_rng(3)
+    n, d, c = 600, 16, 3
+    labels = rng.integers(0, c, n)
+    means = rng.normal(0, 3, (c, d))
+    feats = means[labels] + rng.normal(0, 0.5, (n, d))
+    eta = eta_approximation(feats.astype(np.float32), labels, c,
+                            n_epochs=20, lr=0.05, batch_size=100)
+    assert eta.shape == (n, c)
+    np.testing.assert_allclose(eta.sum(1), 1.0, atol=1e-4)
+    # probe should mostly assign highest η to the true class
+    acc = (eta.argmax(1) == labels).mean()
+    assert acc > 0.9, acc

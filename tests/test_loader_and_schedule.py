@@ -1,0 +1,138 @@
+"""Loader and schedule regression tests for review findings:
+- producer exceptions must surface at the iteration site, not truncate epochs;
+- valid_mask marks wrap-padding exactly;
+- warmup overlays the decay schedule without shifting its milestones;
+- 3-tuple datasets (PLC (image, label, index)) load through ShardedLoader.
+"""
+
+import numpy as np
+import pytest
+
+from ddp_classification_pytorch_tpu.config import OptimConfig
+from ddp_classification_pytorch_tpu.data.loader import ShardedLoader
+from ddp_classification_pytorch_tpu.train.schedule import build_schedule
+
+
+class ExplodingDataset:
+    def __len__(self):
+        return 64
+
+    def __getitem__(self, i, rng=None):
+        if i == 40:
+            raise RuntimeError("corrupt sample")
+        return np.zeros((4, 4, 3), np.float32), 0
+
+
+def test_loader_surfaces_worker_errors():
+    loader = ShardedLoader(ExplodingDataset(), batch_size=8, shuffle=False,
+                           num_workers=2, host_id=0, num_hosts=1)
+    with pytest.raises(RuntimeError, match="corrupt sample"):
+        list(loader)
+
+
+class TripleDataset:
+    """PLC-style (image, label, index) items."""
+
+    def __len__(self):
+        return 16
+
+    def __getitem__(self, i, rng=None):
+        return np.full((2, 2, 3), i, np.float32), i % 3, i
+
+
+def test_loader_handles_plc_triples():
+    loader = ShardedLoader(TripleDataset(), batch_size=8, shuffle=False,
+                           num_workers=1, host_id=0, num_hosts=1)
+    batches = list(loader)
+    assert len(batches) == 2
+    images, labels = batches[0]
+    assert images.shape == (8, 2, 2, 3)
+    np.testing.assert_array_equal(labels, np.arange(8) % 3)
+
+
+def test_valid_mask_marks_padding():
+    class Tiny:
+        def __len__(self):
+            return 10
+
+        def __getitem__(self, i, rng=None):
+            return np.zeros((2, 2, 3), np.float32), 0
+
+    loader = ShardedLoader(Tiny(), batch_size=4, shuffle=False,
+                           host_id=0, num_hosts=1)
+    # 10 samples pad to 12 → batches of 4,4,4; last two rows of batch 2 padded
+    assert len(loader) == 3
+    np.testing.assert_array_equal(loader.valid_mask(0), [1, 1, 1, 1])
+    np.testing.assert_array_equal(loader.valid_mask(1), [1, 1, 1, 1])
+    np.testing.assert_array_equal(loader.valid_mask(2), [1, 1, 0, 0])
+
+
+def test_valid_mask_multihost_padding_on_last_host():
+    class Tiny:
+        def __len__(self):
+            return 10
+
+        def __getitem__(self, i, rng=None):
+            return np.zeros((2, 2, 3), np.float32), 0
+
+    # 2 hosts × batch 4 → chunk 8, pad 10 → 16, per-host 8 (2 batches each)
+    m0 = [ShardedLoader(Tiny(), 4, shuffle=False, host_id=0, num_hosts=2).valid_mask(b)
+          for b in range(2)]
+    m1 = [ShardedLoader(Tiny(), 4, shuffle=False, host_id=1, num_hosts=2).valid_mask(b)
+          for b in range(2)]
+    np.testing.assert_array_equal(np.concatenate(m0), [1] * 8)       # rows 0-7
+    np.testing.assert_array_equal(np.concatenate(m1), [1, 1] + [0] * 6)  # rows 8-9 real
+
+
+def test_tiny_dataset_pads_to_full_batch():
+    class Tiny:
+        def __len__(self):
+            return 5
+
+        def __getitem__(self, i, rng=None):
+            return np.zeros((2, 2, 3), np.float32), i
+
+    # pad (123) far exceeds n (5): the permutation must tile, not truncate
+    loader = ShardedLoader(Tiny(), batch_size=128, shuffle=False,
+                           host_id=0, num_hosts=1)
+    assert len(loader) == 1
+    batches = list(loader)
+    assert batches[0][0].shape[0] == 128
+    np.testing.assert_array_equal(loader.valid_mask(0)[:5], [1] * 5)
+    assert loader.valid_mask(0)[5:].sum() == 0
+
+
+def test_abandoned_iteration_does_not_deadlock():
+    class Slow:
+        def __len__(self):
+            return 64
+
+        def __getitem__(self, i, rng=None):
+            return np.zeros((2, 2, 3), np.float32), 0
+
+    import threading
+
+    loader = ShardedLoader(Slow(), batch_size=8, shuffle=False, prefetch=1,
+                           host_id=0, num_hosts=1)
+    it = iter(loader)
+    next(it)
+    del it  # abandon mid-epoch; producer must exit, not hang on a full queue
+    for _ in range(50):
+        if threading.active_count() <= 2:
+            break
+        import time
+        time.sleep(0.1)
+    # no strict assert on thread count (pytest has helpers), but a second
+    # full iteration must work — would hang if the producer deadlocked
+    assert len(list(loader)) == 8
+
+
+def test_warmup_does_not_shift_milestones():
+    cfg = OptimConfig(lr=1.0, schedule="multistep", milestones=(2, 4),
+                      gamma=0.1, warmup_iters=10, warmup_start_lr=0.0)
+    sched = build_schedule(cfg, steps_per_epoch=10)
+    # milestones anchored at global steps 20 and 40 despite 10-iter warmup
+    assert float(sched(5)) == pytest.approx(0.5)      # mid-warmup ramp
+    assert float(sched(15)) == pytest.approx(1.0)     # post-warmup, pre-decay
+    assert float(sched(20)) == pytest.approx(0.1)     # first milestone on time
+    assert float(sched(40)) == pytest.approx(0.01)    # second milestone on time

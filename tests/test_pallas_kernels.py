@@ -1,0 +1,81 @@
+"""Fused BN+LeakyReLU Pallas kernel vs pure-jnp oracle — values and exact
+gradients (including the batch-statistics terms of the BN backward)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from ddp_classification_pytorch_tpu.ops.pallas_kernels import (
+    batch_norm_leaky_relu,
+    fused_bn_leaky_relu,
+)
+
+
+def oracle_bn_leaky(x, scale, bias, eps=1e-5, slope=0.01):
+    red = tuple(range(x.ndim - 1))
+    mean = jnp.mean(x, axis=red)
+    var = jnp.mean(jnp.square(x), axis=red) - jnp.square(mean)
+    x_hat = (x - mean) * jax.lax.rsqrt(var + eps)
+    y = x_hat * scale + bias
+    return jnp.where(y >= 0, y, y * slope)
+
+
+@pytest.fixture
+def data():
+    rng = np.random.default_rng(0)
+    x = rng.normal(1.5, 2.0, (4, 8, 8, 128)).astype(np.float32)
+    scale = rng.normal(1.0, 0.2, (128,)).astype(np.float32)
+    bias = rng.normal(0.0, 0.2, (128,)).astype(np.float32)
+    return jnp.asarray(x), jnp.asarray(scale), jnp.asarray(bias)
+
+
+def test_forward_matches_oracle(data):
+    x, scale, bias = data
+    y, mean, var = batch_norm_leaky_relu(x, scale, bias)
+    ref = oracle_bn_leaky(x, scale, bias)
+    np.testing.assert_allclose(np.asarray(y), np.asarray(ref), atol=1e-5, rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(mean), np.asarray(x.mean((0, 1, 2))), atol=1e-5)
+
+
+def test_gradients_match_oracle(data):
+    x, scale, bias = data
+
+    def loss_fused(x, s, b):
+        y, _, _ = batch_norm_leaky_relu(x, s, b)
+        return jnp.sum(y * jnp.cos(y))  # nonlinear reduction exercises dy
+
+    def loss_oracle(x, s, b):
+        y = oracle_bn_leaky(x, s, b)
+        return jnp.sum(y * jnp.cos(y))
+
+    g_fused = jax.grad(loss_fused, argnums=(0, 1, 2))(x, scale, bias)
+    g_ref = jax.grad(loss_oracle, argnums=(0, 1, 2))(x, scale, bias)
+    for a, b_, name in zip(g_fused, g_ref, ("dx", "dscale", "dbias")):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b_), atol=2e-4, rtol=2e-4, err_msg=name
+        )
+
+
+def test_inference_mode_with_running_stats(data):
+    x, scale, bias = data
+    mean = jnp.full((128,), 0.7)
+    var = jnp.full((128,), 2.3)
+    y = fused_bn_leaky_relu(x, scale, bias, mean, var)
+    x_hat = (x - mean) * jax.lax.rsqrt(var + 1e-5)
+    ref = x_hat * scale + bias
+    ref = jnp.where(ref >= 0, ref, ref * 0.01)
+    np.testing.assert_allclose(np.asarray(y), np.asarray(ref), atol=1e-5, rtol=1e-5)
+
+
+def test_non_128_channels_and_bf16():
+    rng = np.random.default_rng(1)
+    x = jnp.asarray(rng.normal(0, 1, (2, 4, 4, 64)), jnp.bfloat16)
+    scale = jnp.ones((64,))
+    bias = jnp.zeros((64,))
+    y, _, _ = batch_norm_leaky_relu(x, scale, bias)
+    assert y.dtype == jnp.bfloat16
+    ref = oracle_bn_leaky(x.astype(jnp.float32), scale, bias)
+    np.testing.assert_allclose(
+        np.asarray(y, np.float32), np.asarray(ref), atol=0.05
+    )

@@ -1,0 +1,109 @@
+"""PLC correction-loop tests.
+
+The label-correction *algorithms* are unit-tested in test_labelnoise.py; here
+we test the LOOP mechanics deterministically — f(x) collection order, label
+write-back, δ carry-over — plus an e2e smoke run. (Whether a net repairs
+labels on a given task is a research-dynamics property — early-learning vs
+memorization — not a framework invariant, so no accuracy-of-repair assertion
+on a live net.)"""
+
+import numpy as np
+
+from ddp_classification_pytorch_tpu.config import get_preset
+from ddp_classification_pytorch_tpu.data.synthetic import SyntheticDataset
+from ddp_classification_pytorch_tpu.train.plc_loop import PLCTrainer
+
+
+def _tiny_cfg(tmp_path, epochs=2):
+    cfg = get_preset("plc")
+    cfg.data.dataset = "synthetic"
+    cfg.data.image_size = 32
+    cfg.data.num_classes = 4
+    cfg.data.synthetic_size = 128
+    cfg.data.batch_size = 32
+    cfg.data.num_workers = 2
+    cfg.model.arch = "resnet18"
+    cfg.model.variant = "cifar"
+    cfg.model.dtype = "float32"
+    cfg.optim.lr = 0.01
+    cfg.optim.schedule = "constant"
+    cfg.run.epochs = epochs
+    cfg.run.write_records = False
+    cfg.run.save_every_epoch = False
+    cfg.run.out_dir = str(tmp_path)
+    cfg.plc.warmup_epochs = 0
+    cfg.plc.correction = "lrt"
+    return cfg
+
+
+def test_correct_labels_flips_by_oracle_predictions(tmp_path, monkeypatch):
+    cfg = _tiny_cfg(tmp_path)
+    train_ds = SyntheticDataset(128, 32, 4, seed=999)
+    val_ds = SyntheticDataset(32, 32, 4, seed=999, item_offset=128)
+    tr = PLCTrainer(cfg, train_ds, val_ds)
+
+    clean = train_ds.labels.copy()
+    noisy = clean.copy()
+    noisy[:32] = (clean[:32] + 1) % 4  # corrupt the first 32
+    train_ds.labels = noisy.astype(np.int32)
+
+    # oracle predictions: fully confident in the CLEAN label
+    oracle = np.full((128, 4), -10.0, np.float32)
+    oracle[np.arange(128), clean] = 10.0
+    monkeypatch.setattr(tr, "predict_train_logits", lambda: oracle)
+
+    changed = tr.correct_labels()
+    assert changed == 32
+    np.testing.assert_array_equal(np.asarray(train_ds.labels), clean)
+    # LRT flipped ≥0.1% of labels → δ must NOT grow
+    assert tr.delta == cfg.plc.current_delta
+
+
+def test_delta_grows_when_nothing_corrected(tmp_path, monkeypatch):
+    cfg = _tiny_cfg(tmp_path)
+    train_ds = SyntheticDataset(128, 32, 4, seed=999)
+    val_ds = SyntheticDataset(32, 32, 4, seed=999, item_offset=128)
+    tr = PLCTrainer(cfg, train_ds, val_ds)
+
+    labels = np.asarray(train_ds.labels)
+    agree = np.full((128, 4), -10.0, np.float32)
+    agree[np.arange(128), labels] = 10.0  # predictions agree with labels
+    monkeypatch.setattr(tr, "predict_train_logits", lambda: agree)
+
+    assert tr.correct_labels() == 0
+    assert tr.delta == cfg.plc.current_delta + cfg.plc.delta_increment
+
+
+def test_predict_train_logits_order_and_shape(tmp_path):
+    cfg = _tiny_cfg(tmp_path)
+    # non-multiple of batch size exercises the wrap-padding slice
+    train_ds = SyntheticDataset(100, 32, 4, seed=999)
+    val_ds = SyntheticDataset(32, 32, 4, seed=999, item_offset=100)
+    tr = PLCTrainer(cfg, train_ds, val_ds)
+    f_x = tr.predict_train_logits()
+    assert f_x.shape == (100, 4)
+    assert np.isfinite(f_x).all()
+
+
+def test_plc_e2e_smoke(tmp_path):
+    cfg = _tiny_cfg(tmp_path, epochs=2)
+    train_ds = SyntheticDataset(128, 32, 4, seed=999)
+    val_ds = SyntheticDataset(32, 32, 4, seed=999, item_offset=128)
+    tr = PLCTrainer(cfg, train_ds, val_ds)
+    last = tr.run()
+    assert np.isfinite(last["loss"])
+    assert "corrected" in last and "delta" in last
+
+
+def test_noise_injection_at_init(tmp_path):
+    cfg = _tiny_cfg(tmp_path)
+    cfg.plc.noise_type = 1
+    train_ds = SyntheticDataset(128, 32, 4, seed=999)
+    val_ds = SyntheticDataset(32, 32, 4, seed=999, item_offset=128)
+    clean = train_ds.labels.copy()
+    rng = np.random.default_rng(5)
+    eta = rng.random((128, 4)) * 0.2
+    eta[np.arange(128), clean] += 1.0
+    eta /= eta.sum(1, keepdims=True)
+    tr = PLCTrainer(cfg, train_ds, val_ds, eta=eta)
+    assert int((np.asarray(train_ds.labels) != clean).sum()) > 0
