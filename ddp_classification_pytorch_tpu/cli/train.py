@@ -56,6 +56,9 @@ def build_parser() -> argparse.ArgumentParser:
     m.add_argument("--variant", default="", help="imagenet | cifar stem")
     m.add_argument("--pretrained", action="store_true",
                    help="load converted torchvision weights")
+    m.add_argument("--pretrained_path", default="",
+                   help=".pth/.pt torch checkpoint to import (torchvision "
+                   "state_dict or NESTED {'feat','cls'} format)")
     m.add_argument("--dtype", default="", help="bfloat16 | float32 compute dtype")
     m.add_argument("--dropout", type=float, default=-1.0)
 
@@ -103,6 +106,12 @@ def build_parser() -> argparse.ArgumentParser:
     r.add_argument("--resume", default="", help="checkpoint path to resume from")
     r.add_argument("--log_every", type=int, default=0)
     r.add_argument("--save_best_only", action="store_true")
+    r.add_argument("--profile_steps", type=int, default=0,
+                   help="capture a jax.profiler trace of N train steps")
+    r.add_argument("--debug_nans", action="store_true",
+                   help="enable jax_debug_nans (fail fast on NaN)")
+    r.add_argument("--grad_accum", type=int, default=0,
+                   help="microbatch accumulation factor")
     r.add_argument("--platform", default="", choices=["", "tpu", "cpu"],
                    help="force a JAX platform (the north star's --device branch); "
                    "default: whatever jax finds (TPU when present)")
@@ -146,6 +155,9 @@ def config_from_args(args: argparse.Namespace) -> Config:
         cfg.model.variant = args.variant
     if args.pretrained:
         cfg.model.pretrained = True
+    if args.pretrained_path:
+        cfg.model.pretrained = True
+        cfg.model.pretrained_path = args.pretrained_path
     if args.dtype:
         cfg.model.dtype = args.dtype
     if args.dropout >= 0:
@@ -193,6 +205,12 @@ def config_from_args(args: argparse.Namespace) -> Config:
         cfg.run.log_every = args.log_every
     if args.save_best_only:
         cfg.run.save_best_only = True
+    if args.profile_steps:
+        cfg.run.profile_steps = args.profile_steps
+    if args.debug_nans:
+        cfg.run.debug_nans = True
+    if args.grad_accum:
+        cfg.parallel.grad_accum = args.grad_accum
 
     if args.correction:
         cfg.plc.correction = args.correction
@@ -227,6 +245,9 @@ def main(argv: Optional[Sequence[str]] = None) -> None:
 
     cfg = config_from_args(args)
     set_seed(cfg.run.seed)
+    if cfg.run.debug_nans:
+        import jax
+        jax.config.update("jax_debug_nans", True)
     trainer_cls = PLCTrainer if cfg.workload == "plc" else Trainer
     trainer = trainer_cls(cfg)
     trainer.run()

@@ -55,7 +55,11 @@ class ModelConfig:
 
     arch: str = "resnet50"
     variant: str = "imagenet"  # imagenet | cifar
-    pretrained: bool = False  # torchvision-weight import hook (round 2+)
+    pretrained: bool = False  # load converted torchvision weights at init
+    # path to a torch .pth/.pt checkpoint (torchvision state_dict, a
+    # {'state_dict': ...} wrapper, or the reference's NESTED {'feat','cls'}
+    # format). Zero-egress environments supply the file; no URL download.
+    pretrained_path: str = ""
     feat_dim: int = 0  # 0 = arch default (512 r18/34, 2048 r50+)
     head: str = "fc"  # fc | arcface | nested
     # ArcFace (ARCFACE/arc_main.py:234: s=30, m=0.5, easy_margin=True)
@@ -146,6 +150,11 @@ class RunConfig:
     save_best_only: bool = False  # NESTED netBest.pth policy, train.py:154-161
     resume: str = ""  # NESTED --resumePth, train.py:372-378
     write_records: bool = True  # output.txt / history.json (SURVEY C23)
+    # observability (SURVEY §5 tracing/race-detection rows — the reference has
+    # ad-hoc wall-clock timers only)
+    profile_steps: int = 0  # >0: capture a jax.profiler trace of steps [10, 10+N)
+    profile_dir: str = ""   # default: <out_dir>/profile
+    debug_nans: bool = False  # jax_debug_nans for fail-fast numeric debugging
 
 
 @dataclass

@@ -11,6 +11,7 @@ import jax.numpy as jnp
 
 from ..config import ModelConfig
 from . import resnet as _resnet
+from .tresnet import tresnet_m
 from .vgg import vgg19_bn
 from .heads import ArcEmbedding, ArcMarginHead, NetClassifier
 
@@ -30,6 +31,8 @@ def feat_dim_for(cfg: ModelConfig) -> int:
         return _resnet.FEAT_DIMS[cfg.arch]
     if cfg.arch == "vgg19_bn":
         return 4096
+    if cfg.arch in ("tresnet_m", "timm"):
+        return 2048
     raise ValueError(f"unknown arch {cfg.arch}")
 
 
@@ -45,6 +48,9 @@ def build_backbone(cfg: ModelConfig, num_classes: int = 0,
     if cfg.arch == "vgg19_bn":
         return vgg19_bn(num_classes=num_classes, dtype=dtype,
                         axis_name=axis_name, dropout=cfg.dropout or 0.5)
+    if cfg.arch in ("tresnet_m", "timm"):
+        # reference `--model timm` → tresnet_m_miil_in21k (BASELINE/main.py:141-144)
+        return tresnet_m(num_classes=num_classes, dtype=dtype)
     raise ValueError(f"unknown arch {cfg.arch!r}")
 
 

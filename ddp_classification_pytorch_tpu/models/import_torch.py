@@ -1,0 +1,181 @@
+"""torchvision-checkpoint → Flax parameter import.
+
+The reference defaults every trainer to `pretrained=True` torchvision weights
+(BASELINE/main.py:135, CDR/main.py:330, NESTED via
+imagenet_resnet.py:195-203 model-zoo URLs) — matching its convergence
+requires loading the same checkpoints (SURVEY §7.3 #2). This module maps a
+torch `state_dict` (from `torch.load(...)`, `torch.hub` caches, or the
+reference's own NESTED `{'feat','cls'}` checkpoints, NESTED/train.py:158-161)
+onto the Flax ResNet tree in `models/resnet.py`.
+
+Conventions handled:
+- conv `weight` (O, I, kH, kW) → flax `kernel` (kH, kW, I, O);
+- linear `weight` (O, I) → `kernel` (I, O);
+- BN `weight/bias` → params `scale/bias`; `running_mean/var` → batch_stats
+  `mean/var` (num_batches_tracked dropped);
+- torchvision names (`layer1.0.conv2`, `downsample.0/1`) → flax module names
+  (`layer1_block0/Conv_1`, `downsample_conv`/`downsample_bn`).
+
+`models/resnet.py` uses torch-equivalent explicit conv padding specifically
+so the imported weights are numerically exact (see conv() there).
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Any, Dict, Mapping, Tuple
+
+import numpy as np
+
+
+def _to_numpy(t: Any) -> np.ndarray:
+    if hasattr(t, "detach"):  # torch tensor without importing torch here
+        t = t.detach().cpu().numpy()
+    return np.asarray(t)
+
+
+def _conv_kernel(w: np.ndarray) -> np.ndarray:
+    return _to_numpy(w).transpose(2, 3, 1, 0)  # OIHW → HWIO
+
+
+def _convert_key(key: str) -> Tuple[Tuple[str, ...], str, str]:
+    """torch state_dict key → (flax module path, leaf name, collection)."""
+    parts = key.split(".")
+
+    def bn_leaf(leaf: str) -> Tuple[str, str]:
+        return {
+            "weight": ("scale", "params"),
+            "bias": ("bias", "params"),
+            "running_mean": ("mean", "batch_stats"),
+            "running_var": ("var", "batch_stats"),
+        }[leaf]
+
+    if parts[0] == "conv1":
+        return ("conv_stem",), "kernel", "params"
+    if parts[0] == "bn1":
+        leaf, coll = bn_leaf(parts[1])
+        return ("bn_stem",), leaf, coll
+    if parts[0] == "fc":
+        return ("fc",), {"weight": "kernel", "bias": "bias"}[parts[1]], "params"
+
+    m = re.fullmatch(r"layer(\d+)", parts[0])
+    if m is None:
+        raise KeyError(f"unrecognized torch key {key!r}")
+    block = f"layer{m.group(1)}_block{parts[1]}"
+
+    sub = parts[2]
+    if sub == "downsample":
+        if parts[3] == "0":
+            return (block, "downsample_conv"), "kernel", "params"
+        leaf, coll = bn_leaf(parts[4])
+        return (block, "downsample_bn"), leaf, coll
+    m2 = re.fullmatch(r"conv(\d+)", sub)
+    if m2:
+        return (block, f"Conv_{int(m2.group(1)) - 1}"), "kernel", "params"
+    m3 = re.fullmatch(r"bn(\d+)", sub)
+    if m3:
+        leaf, coll = bn_leaf(parts[3])
+        return (block, f"BatchNorm_{int(m3.group(1)) - 1}"), leaf, coll
+    raise KeyError(f"unrecognized torch key {key!r}")
+
+
+_NESTED_SEQ = {"0": "conv1", "1": "bn1", "4": "layer1", "5": "layer2",
+               "6": "layer3", "7": "layer4"}
+
+
+def _normalize_nested_key(key: str) -> str:
+    """`feat_net.<i>...` (reference NetFeat Sequential over
+    [conv1,bn1,relu,maxpool,layer1..4,avgpool], NESTED/model/model.py:37-40)
+    → torchvision names."""
+    if not key.startswith("feat_net."):
+        return key
+    parts = key.split(".")
+    mapped = _NESTED_SEQ.get(parts[1])
+    if mapped is None:
+        return key  # relu/maxpool/avgpool carry no params
+    return ".".join([mapped] + parts[2:])
+
+
+def convert_resnet_state_dict(
+    state_dict: Mapping[str, Any],
+    include_fc: bool = True,
+) -> Dict[str, Dict]:
+    """→ {'params': ..., 'batch_stats': ...} nested dicts of numpy arrays.
+
+    Unknown keys (`num_batches_tracked`, the reference's vestigial
+    mean_vector/count_vector/label buffers, imagenet_resnet.py:119-121) are
+    skipped. `include_fc=False` drops the classifier head (feature-extractor
+    import, the NESTED NetFeat role)."""
+    out: Dict[str, Dict] = {"params": {}, "batch_stats": {}}
+    skipped = []
+    for key, value in state_dict.items():
+        key = _normalize_nested_key(key)
+        if key.endswith("num_batches_tracked"):
+            continue
+        if key.split(".")[0] in ("mean_vector", "count_vector", "label"):
+            continue  # vestigial buffers
+        if not include_fc and key.startswith("fc."):
+            continue
+        try:
+            path, leaf, coll = _convert_key(key)
+        except KeyError:
+            skipped.append(key)
+            continue
+        arr = _to_numpy(value)
+        if leaf == "kernel" and arr.ndim == 4:
+            arr = _conv_kernel(value)
+        elif leaf == "kernel" and arr.ndim == 2:
+            arr = arr.T  # linear (O, I) → (I, O)
+        node = out[coll]
+        for p in path:
+            node = node.setdefault(p, {})
+        node[leaf] = arr
+    if not out["params"]:
+        # a silently-empty conversion would leave the model at random init
+        # while the user believes pretrained weights loaded
+        raise ValueError(
+            "checkpoint contained no convertible ResNet weights "
+            f"(unrecognized keys, first few: {skipped[:5]}); supported formats: "
+            "torchvision resnet state_dict, {'state_dict': ...} wrappers, "
+            "reference NESTED feat_net checkpoints")
+    return out
+
+
+def merge_into_variables(variables: Dict, converted: Dict) -> Dict:
+    """Overlay converted arrays onto an initialized Flax variables tree,
+    validating shapes; leaves absent from the checkpoint keep their init."""
+    import jax
+
+    def overlay(init_node, conv_node, path=""):
+        if not isinstance(init_node, dict):
+            if init_node.shape != conv_node.shape:
+                raise ValueError(
+                    f"shape mismatch at {path}: init {init_node.shape} vs "
+                    f"checkpoint {conv_node.shape}")
+            return jax.numpy.asarray(conv_node, dtype=init_node.dtype)
+        out = dict(init_node)
+        for k, v in conv_node.items():
+            if k not in init_node:
+                raise KeyError(f"checkpoint key {path}/{k} not in model tree")
+            out[k] = overlay(init_node[k], v, f"{path}/{k}")
+        return out
+
+    merged = dict(variables)
+    for coll in ("params", "batch_stats"):
+        if coll in converted and converted[coll]:
+            merged[coll] = overlay(variables[coll], converted[coll], coll)
+    return merged
+
+
+def load_torch_checkpoint(path: str) -> Mapping[str, Any]:
+    """Load a .pth/.pt state_dict (torch is a baked-in host dependency).
+    Accepts raw state_dicts, `{'state_dict': ...}` wrappers, and the
+    reference's NESTED `{'feat': ..., 'cls': ...}` format (feat only)."""
+    import torch
+
+    obj = torch.load(path, map_location="cpu", weights_only=True)
+    if isinstance(obj, dict) and "state_dict" in obj:
+        obj = obj["state_dict"]
+    if isinstance(obj, dict) and "feat" in obj and "cls" in obj:
+        obj = obj["feat"]
+    return obj

@@ -116,10 +116,19 @@ class ResNet(nn.Module):
 
     @nn.compact
     def __call__(self, x: jnp.ndarray, train: bool = True) -> jnp.ndarray:
-        conv = functools.partial(
-            nn.Conv, use_bias=False, dtype=self.dtype, padding="SAME",
-            kernel_init=nn.initializers.variance_scaling(2.0, "fan_out", "truncated_normal"),
-        )
+        def conv(features, kernel_size, strides=(1, 1), **kw):
+            # torch-equivalent explicit padding (k//2 both sides): identical to
+            # SAME at stride 1, but at stride 2 SAME pads asymmetrically and
+            # shifts the grid — explicit padding keeps imported torchvision
+            # weights numerically exact (imagenet_resnet.py pad semantics)
+            k = kernel_size[0]
+            return nn.Conv(
+                features, kernel_size, strides=strides, use_bias=False,
+                dtype=self.dtype, padding=[(k // 2, k // 2)] * 2,
+                kernel_init=nn.initializers.variance_scaling(
+                    2.0, "fan_out", "truncated_normal"),
+                **kw,
+            )
         use_running = (not train) or self.freeze_bn
         norm = functools.partial(
             nn.BatchNorm, use_running_average=use_running,
@@ -135,7 +144,9 @@ class ResNet(nn.Module):
         x = norm(name="bn_stem")(x)
         x = nn.relu(x)
         if not self.cifar_stem:
-            x = nn.max_pool(x, (3, 3), strides=(2, 2), padding="SAME")
+            # torch MaxPool2d(3, 2, padding=1); flax max_pool pads with -inf,
+            # matching torch's border semantics
+            x = nn.max_pool(x, (3, 3), strides=(2, 2), padding=[(1, 1), (1, 1)])
 
         for i, n_blocks in enumerate(self.stage_sizes):
             for j in range(n_blocks):

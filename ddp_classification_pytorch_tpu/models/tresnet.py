@@ -1,0 +1,212 @@
+"""TResNet-M backbone — the reference's `timm` high-throughput option.
+
+Parity target: `timm.create_model('tresnet_m_miil_in21k', num_classes=...)`
+selected by `--model timm` (BASELINE/main.py:141-144), whose native
+dependency is the `inplace_abn` CUDA extension (requirements.txt:5-8). Here
+every ABN site uses `ops.pallas_kernels` — the Pallas fused
+BatchNorm+LeakyReLU with exact VJP — so the model is TPU-native end to end.
+
+Architecture (TResNet: "TResNet: High Performance GPU-Dedicated
+Architecture", Ridnik et al. 2020), re-derived for NHWC/XLA:
+- SpaceToDepth stem (×4 patchify → conv 3×3) instead of conv7×7+maxpool —
+  a reshape/transpose XLA fuses for free, MXU-friendly from layer 1;
+- stages [3, 4, 11, 3] for TResNet-M: BasicBlock in stages 1-2,
+  Bottleneck in 3-4; widths 64·s, 128·s, 256·s, 512·s (s=1 for M);
+- Leaky-ReLU (slope 1e-3) everywhere via the fused ABN kernel;
+- SE blocks in stages 1-3 (reduction 4 basic / 8 bottleneck);
+- anti-aliased stride-2 downsampling approximated by the standard strided
+  conv (the blur-pool filter is a fixed 3×3 depthwise conv — included,
+  since it is one cheap fused conv on TPU).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Optional, Sequence
+
+import flax.linen as nn
+import jax.numpy as jnp
+import numpy as np
+
+from ..ops.pallas_kernels import batch_norm_leaky_relu, fused_bn_leaky_relu
+
+SLOPE = 1e-3  # TResNet's leaky-relu slope (inplace_abn activation_param)
+
+
+class FusedABN(nn.Module):
+    """BatchNorm + LeakyReLU as one Pallas kernel, with running stats kept in
+    the `batch_stats` collection (flax BatchNorm conventions)."""
+
+    momentum: float = 0.9
+    epsilon: float = 1e-5
+    slope: float = SLOPE
+    use_running_average: bool = False
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
+        c = x.shape[-1]
+        scale = self.param("scale", nn.initializers.ones, (c,), jnp.float32)
+        bias = self.param("bias", nn.initializers.zeros, (c,), jnp.float32)
+        ra_mean = self.variable("batch_stats", "mean",
+                                lambda: jnp.zeros((c,), jnp.float32))
+        ra_var = self.variable("batch_stats", "var",
+                               lambda: jnp.ones((c,), jnp.float32))
+        if self.use_running_average:
+            return fused_bn_leaky_relu(
+                x, scale, bias, ra_mean.value, ra_var.value,
+                self.epsilon, self.slope)
+        y, mean, var = batch_norm_leaky_relu(
+            x, scale, bias, self.epsilon, self.slope)
+        if not self.is_initializing():
+            ra_mean.value = self.momentum * ra_mean.value + (1 - self.momentum) * mean
+            ra_var.value = self.momentum * ra_var.value + (1 - self.momentum) * var
+        return y
+
+
+def space_to_depth(x: jnp.ndarray, block: int = 4) -> jnp.ndarray:
+    """(B, H, W, C) → (B, H/b, W/b, C·b²) — the TResNet stem patchify."""
+    b, h, w, c = x.shape
+    x = x.reshape(b, h // block, block, w // block, block, c)
+    return x.transpose(0, 1, 3, 2, 4, 5).reshape(b, h // block, w // block, c * block * block)
+
+
+class BlurPool(nn.Module):
+    """Fixed 3×3 binomial depthwise blur + stride 2 (TResNet's anti-aliased
+    downsampling). The filter is a constant, not a parameter — one depthwise
+    conv XLA fuses with the adjacent strided conv."""
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
+        import jax.lax as lax
+
+        c = x.shape[-1]
+        k2 = np.outer([1.0, 2.0, 1.0], [1.0, 2.0, 1.0])
+        k2 /= k2.sum()
+        kernel = jnp.asarray(np.tile(k2[:, :, None, None], (1, 1, 1, c)), x.dtype)
+        return lax.conv_general_dilated(
+            x, kernel, window_strides=(2, 2), padding="SAME",
+            dimension_numbers=("NHWC", "HWIO", "NHWC"),
+            feature_group_count=c,
+        )
+
+
+class SE(nn.Module):
+    """Squeeze-excitation (TResNet places it after conv2 in basic blocks,
+    between conv2/conv3 in bottlenecks)."""
+
+    reduction: int
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
+        c = x.shape[-1]
+        s = jnp.mean(x.astype(jnp.float32), axis=(1, 2))
+        s = nn.relu(nn.Dense(max(c // self.reduction, 8), name="fc1")(s))
+        s = nn.sigmoid(nn.Dense(c, name="fc2")(s))
+        return x * s[:, None, None, :].astype(x.dtype)
+
+
+class TBasicBlock(nn.Module):
+    filters: int
+    strides: int
+    use_se: bool
+    abn: Any
+    dtype: Any = jnp.bfloat16
+    expansion: int = 1
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
+        residual = x
+        conv = functools.partial(nn.Conv, use_bias=False, dtype=self.dtype, padding="SAME")
+        y = conv(self.filters, (3, 3))(x) if self.strides == 1 else conv(
+            self.filters, (3, 3))(BlurPool(name="aa")(x))
+        y = self.abn()(y)
+        y = conv(self.filters, (3, 3))(y)
+        # final BN without activation: plain BatchNorm, relu applied after add
+        y = nn.BatchNorm(use_running_average=self.abn.keywords["use_running_average"],
+                         momentum=0.9, epsilon=1e-5, dtype=self.dtype, name="bn2")(y)
+        if self.use_se:
+            y = SE(reduction=4, name="se")(y)
+        if residual.shape != y.shape:
+            r = residual if self.strides == 1 else BlurPool(name="aa_down")(residual)
+            r = nn.Conv(self.filters, (1, 1), use_bias=False, dtype=self.dtype,
+                        name="downsample")(r)
+            residual = nn.BatchNorm(
+                use_running_average=self.abn.keywords["use_running_average"],
+                momentum=0.9, epsilon=1e-5, dtype=self.dtype, name="bn_down")(r)
+        return nn.leaky_relu(y + residual, SLOPE)
+
+
+class TBottleneck(nn.Module):
+    filters: int
+    strides: int
+    use_se: bool
+    abn: Any
+    dtype: Any = jnp.bfloat16
+    expansion: int = 4
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
+        residual = x
+        conv = functools.partial(nn.Conv, use_bias=False, dtype=self.dtype, padding="SAME")
+        y = conv(self.filters, (1, 1))(x)
+        y = self.abn()(y)
+        y = conv(self.filters, (3, 3))(y if self.strides == 1 else BlurPool(name="aa")(y))
+        y = self.abn()(y)
+        if self.use_se:
+            y = SE(reduction=8, name="se")(y)
+        y = conv(self.filters * self.expansion, (1, 1))(y)
+        y = nn.BatchNorm(use_running_average=self.abn.keywords["use_running_average"],
+                         momentum=0.9, epsilon=1e-5, dtype=self.dtype, name="bn3")(y)
+        if residual.shape != y.shape:
+            r = residual if self.strides == 1 else BlurPool(name="aa_down")(residual)
+            r = nn.Conv(self.filters * self.expansion, (1, 1), use_bias=False,
+                        dtype=self.dtype, name="downsample")(r)
+            residual = nn.BatchNorm(
+                use_running_average=self.abn.keywords["use_running_average"],
+                momentum=0.9, epsilon=1e-5, dtype=self.dtype, name="bn_down")(r)
+        return nn.leaky_relu(y + residual, SLOPE)
+
+
+class TResNet(nn.Module):
+    """TResNet-M topology: stages [3,4,11,3], width factor 1."""
+
+    num_classes: int = 0
+    stages: Sequence[int] = (3, 4, 11, 3)
+    width: float = 1.0
+    dtype: Any = jnp.bfloat16
+    feat_dim_out: int = 2048
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray, train: bool = True) -> jnp.ndarray:
+        abn = functools.partial(FusedABN, use_running_average=not train)
+        w = int(64 * self.width)
+        x = space_to_depth(x.astype(self.dtype), 4)
+        x = nn.Conv(w, (3, 3), use_bias=False, dtype=self.dtype, padding="SAME",
+                    name="stem_conv")(x)
+        x = abn(name="stem_abn")(x)
+
+        plan = [
+            (TBasicBlock, w, 1, True),        # stage 1
+            (TBasicBlock, w * 2, 2, True),    # stage 2
+            (TBottleneck, w * 4, 2, True),    # stage 3 (SE)
+            (TBottleneck, w * 8, 2, False),   # stage 4 (no SE)
+        ]
+        for s, (block, filters, stride, use_se) in enumerate(plan):
+            for b in range(self.stages[s]):
+                x = block(
+                    filters=filters,
+                    strides=stride if b == 0 else 1,
+                    use_se=use_se,
+                    abn=abn,
+                    dtype=self.dtype,
+                    name=f"stage{s + 1}_block{b}",
+                )(x)
+
+        x = jnp.mean(x.astype(jnp.float32), axis=(1, 2))
+        if self.num_classes:
+            x = nn.Dense(self.num_classes, dtype=jnp.float32, name="fc")(x)
+        return x
+
+
+def tresnet_m(num_classes: int = 0, dtype=jnp.bfloat16, **_: Any) -> TResNet:
+    return TResNet(num_classes=num_classes, dtype=dtype)

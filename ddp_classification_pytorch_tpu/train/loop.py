@@ -82,6 +82,15 @@ def build_datasets(cfg: Config) -> Tuple[Any, Any]:
     raise ValueError(f"unknown dataset {d.dataset!r}")
 
 
+def _profiling_unsupported() -> bool:
+    """jax.profiler.start_trace wedges tunneled TPU plugins (observed: the
+    whole PJRT client hangs until the lease expires). Gate it off there."""
+    import os
+
+    return bool(os.environ.get("PALLAS_AXON_POOL_IPS")) or (
+        os.environ.get("JAX_PLATFORMS", "") == "axon")
+
+
 class Trainer:
     def __init__(
         self,
@@ -128,6 +137,7 @@ class Trainer:
             if cfg.model.head == "nested" else None
         )
 
+        self._setup_profiler()
         self.records = RecordWriter(cfg.run.out_dir) if cfg.run.write_records else None
         self.ckpt = CheckpointManager(
             cfg.run.out_dir,
@@ -150,14 +160,47 @@ class Trainer:
             f"steps/epoch={self.steps_per_epoch}"
         )
 
+    # -------------------------------------------------------------- profile --
+    def _setup_profiler(self) -> None:
+        """Resolve the jax.profiler window once (SURVEY §5 tracing row)."""
+        cfg = self.cfg
+        self._prof_steps = cfg.run.profile_steps
+        self._prof_dir = cfg.run.profile_dir or f"{cfg.run.out_dir}/profile"
+        self._prof_active = False
+        if self._prof_steps and _profiling_unsupported():
+            host0_print("[trainer] profiler disabled: tunneled/remote TPU "
+                        "plugin (jax.profiler hangs through the relay)")
+            self._prof_steps = 0
+        # skip a few warmup/compile steps when the epoch affords it
+        self._prof_start_step = min(10, max(self.steps_per_epoch - self._prof_steps, 0))
+
+    def _maybe_profile_start(self, epoch: int, step: int) -> None:
+        if (self._prof_steps and epoch == 0 and not self._prof_active
+                and step == self._prof_start_step):
+            jax.profiler.start_trace(self._prof_dir)
+            self._prof_active = True
+
+    def _maybe_profile_stop(self, epoch: int, step: int, metrics) -> None:
+        if not self._prof_active:
+            return
+        done = step - self._prof_start_step + 1 >= self._prof_steps
+        if done or step == self.steps_per_epoch - 1:  # never leak past epoch 0
+            jax.block_until_ready(metrics)
+            jax.profiler.stop_trace()
+            self._prof_active = False
+            self._prof_steps = 0
+            host0_print(f"[trainer] profiler trace captured → {self._prof_dir}")
+
     # ---------------------------------------------------------------- train --
     def train_epoch(self, epoch: int, eta: Optional[EtaLogger] = None) -> Dict[str, float]:
         self.train_loader.set_epoch(epoch)
         sums = None  # device-side accumulation: no per-step host sync, so the
         n_batches = 0  # host keeps dispatching ahead of the device
         for step, (images, labels) in enumerate(self.train_loader):
+            self._maybe_profile_start(epoch, step)
             batch = meshlib.make_global_array((images, labels), self.mesh)
             self.state, metrics = self.train_step(self.state, *batch)
+            self._maybe_profile_stop(epoch, step, metrics)
             n_batches += 1
             sums = metrics if sums is None else jax.tree_util.tree_map(
                 jax.numpy.add, sums, metrics)

@@ -27,7 +27,8 @@ from ..config import OptimConfig
 from ..ops.cdr import cdr_gradient_transform
 
 
-def build_schedule(cfg: OptimConfig, steps_per_epoch: int) -> optax.Schedule:
+def build_schedule(cfg: OptimConfig, steps_per_epoch: int,
+                   grad_accum: int = 1) -> optax.Schedule:
     if cfg.schedule == "step":
         # lr · γ^(epoch // step_size)
         main = optax.exponential_decay(
@@ -44,16 +45,19 @@ def build_schedule(cfg: OptimConfig, steps_per_epoch: int) -> optax.Schedule:
     else:
         raise ValueError(f"unknown schedule {cfg.schedule!r}")
 
-    if cfg.warmup_iters > 0:
+    # warmup_iters is specified in ITERATIONS (reference NESTED/train.py:466);
+    # under accumulation the schedule counts optimizer steps, so rescale
+    warmup_iters = max(cfg.warmup_iters // max(grad_accum, 1), 0)
+    if warmup_iters > 0:
         # The reference ramps lr per-iteration while the epoch-indexed decay
         # schedule keeps counting from epoch 0 (NESTED/train.py:292-295 with
         # MultiStepLR stepping per epoch at :447-448). optax.join_schedules
         # would shift `main` by warmup_iters — so overlay instead: decay
         # milestones stay anchored at the true global step.
-        warm = optax.linear_schedule(cfg.warmup_start_lr, cfg.lr, cfg.warmup_iters)
+        warm = optax.linear_schedule(cfg.warmup_start_lr, cfg.lr, warmup_iters)
 
         def overlaid(step):
-            return jnp.where(step < cfg.warmup_iters, warm(step), main(step))
+            return jnp.where(step < warmup_iters, warm(step), main(step))
 
         return overlaid
     return main
@@ -68,8 +72,12 @@ def build_optimizer(
     cfg: OptimConfig,
     steps_per_epoch: int,
     freeze_bn: bool = False,
+    grad_accum: int = 1,
 ) -> optax.GradientTransformationExtraArgs:
-    schedule = build_schedule(cfg, steps_per_epoch)
+    # with accumulation the schedule advances once per OPTIMIZER step, so the
+    # per-epoch schedule length shrinks by the accumulation factor
+    schedule = build_schedule(cfg, max(steps_per_epoch // max(grad_accum, 1), 1),
+                              grad_accum=grad_accum)
     if cfg.optimizer == "sgd":
         base = optax.sgd(schedule, momentum=cfg.momentum)
     elif cfg.optimizer == "adam":
@@ -93,4 +101,10 @@ def build_optimizer(
                 lambda params: jax.tree_util.tree_map_with_path(_is_bn_param, params),
             )
         )
-    return optax.with_extra_args_support(optax.chain(*parts))
+    tx = optax.chain(*parts)
+    if grad_accum > 1:
+        # microbatch accumulation (capability headroom over the reference,
+        # which has none — SURVEY §2.2): k micro-steps average into one
+        # optimizer step, all inside the jitted update
+        tx = optax.MultiSteps(tx, every_k_schedule=grad_accum)
+    return optax.with_extra_args_support(tx)

@@ -66,10 +66,19 @@ def create_train_state(
     else:
         variables = model.init(rngs, img, train=False)
 
+    if cfg.model.pretrained:
+        if not cfg.model.pretrained_path:
+            raise ValueError(
+                "model.pretrained=True requires model.pretrained_path: this "
+                "environment cannot download torchvision weights (zero "
+                "egress); supply a local .pth (torchvision state_dict or "
+                "reference NESTED format) via --pretrained_path")
+        variables = _load_pretrained(cfg, variables)
     params = variables["params"]
     batch_stats = variables.get("batch_stats", {})
 
-    tx = build_optimizer(cfg.optim, steps_per_epoch, freeze_bn=cfg.model.freeze_bn)
+    tx = build_optimizer(cfg.optim, steps_per_epoch, freeze_bn=cfg.model.freeze_bn,
+                         grad_accum=cfg.parallel.grad_accum)
 
     params = jax.device_put(params, meshlib.param_shardings(params, mesh))
     batch_stats = jax.device_put(batch_stats, meshlib.replicated(mesh))
@@ -83,6 +92,43 @@ def create_train_state(
         opt_state=opt_state,
     )
     return model, tx, state
+
+
+def _load_pretrained(cfg: Config, variables):
+    """Overlay converted torchvision weights onto the backbone subtree
+    (reference `pretrained=True` defaults, BASELINE/main.py:135,
+    NESTED imagenet_resnet.py:195-203)."""
+    from ..models.import_torch import (
+        convert_resnet_state_dict,
+        load_torch_checkpoint,
+        merge_into_variables,
+    )
+
+    sd = load_torch_checkpoint(cfg.model.pretrained_path)
+    backbone_params = variables["params"]["backbone"]
+    # import the torchvision fc only when the model keeps a same-width fc
+    # (the reference always replaces it: 1000 → NUM_CLASS, BASELINE:136-139)
+    fc_kernel = backbone_params.get("fc", {}).get("kernel")
+    fc_w = sd.get("fc.weight")
+    include_fc = (
+        fc_kernel is not None and fc_w is not None
+        and tuple(fc_kernel.shape) == tuple(reversed(fc_w.shape))
+    )
+    converted = convert_resnet_state_dict(sd, include_fc=include_fc)
+    sub = {
+        "params": variables["params"]["backbone"],
+        "batch_stats": variables.get("batch_stats", {}).get("backbone", {}),
+    }
+    merged = merge_into_variables(sub, converted)
+    out_params = dict(variables["params"])
+    out_params["backbone"] = merged["params"]
+    out = dict(variables)
+    out["params"] = out_params
+    if "batch_stats" in variables and merged.get("batch_stats"):
+        out_stats = dict(variables["batch_stats"])
+        out_stats["backbone"] = merged["batch_stats"]
+        out["batch_stats"] = out_stats
+    return out
 
 
 def param_count(state: TrainState) -> int:

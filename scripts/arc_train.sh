@@ -1,0 +1,9 @@
+#!/usr/bin/env bash
+# ARCFACE workload (reference ARCFACE/arc_train.sh:1, HPC variant batch 64:
+# arc_train_hpc.sh:1-3): ResNet-50 → 256-d embedding → ArcMarginProduct
+# (s=30, m=0.5, easy_margin), Adam.
+set -euo pipefail
+FOLDER=${1:-/data/food}
+python -m ddp_classification_pytorch_tpu.cli.train arcface \
+  --folder "$FOLDER" --batchsize 64 --model resnet50 --optimizer adam \
+  --lr 0.001 --epochs 100 --out ./runs/arcface "${@:2}"

@@ -1,0 +1,8 @@
+#!/usr/bin/env bash
+# CDR workload (reference CDR/train.sh:1-4): noisy-label robust training with
+# the selective-gradient step; first 100 classes, batch 128, SGD 0.1.
+set -euo pipefail
+FOLDER=${1:-/data/food}
+python -m ddp_classification_pytorch_tpu.cli.train cdr \
+  --folder "$FOLDER" --batchsize 128 --model resnet50 \
+  --lr 0.1 --noise_rate 0.2 --out ./runs/cdr "${@:2}"

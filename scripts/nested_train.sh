@@ -1,0 +1,9 @@
+#!/usr/bin/env bash
+# NESTED workload (reference NESTED/train.sh:1-7): nested-dropout ordered
+# features, 10k-iter warmup, freeze-BN, pretrained backbone, all-K eval.
+set -euo pipefail
+FOLDER=${1:-/data/clothing1m}
+python -m ddp_classification_pytorch_tpu.cli.train nested \
+  --folder "$FOLDER" --batchsize 128 --model resnet50 \
+  --nested 100 --warmUpIter 10000 --freeze-bn --lr 0.01 \
+  --lrSchedule 20 30 40 120 --out ./runs/nested "${@:2}"

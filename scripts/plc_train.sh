@@ -1,0 +1,9 @@
+#!/usr/bin/env bash
+# PLC workload — progressive label correction on Clothing1M-format
+# annotations. The reference shipped only the dataset + algorithms (its
+# README marks PLC "// TODO"); this trainer completes the capability.
+set -euo pipefail
+FOLDER=${1:-/data/clothing1m}
+python -m ddp_classification_pytorch_tpu.cli.train plc \
+  --dataset plc --train_dir "$FOLDER" --batchsize 128 --model resnet50 \
+  --correction lrt --delta 0.3 --out ./runs/plc "${@:2}"

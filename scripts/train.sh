@@ -1,0 +1,12 @@
+#!/usr/bin/env bash
+# BASELINE workload (reference BASELINE/train.sh:1):
+#   CUDA_VISIBLE_DEVICES=0,1 python -m torch.distributed.launch --nproc_per_node=2 \
+#       main.py --world_size=2 --folder=/data/food
+# On TPU there is no per-device process launcher: one process per host sees all
+# local chips and the batch shards over the mesh automatically. The per-GPU
+# batch 16 × 2 GPUs becomes --batchsize 32 (per host).
+set -euo pipefail
+FOLDER=${1:-/data/food}
+python -m ddp_classification_pytorch_tpu.cli.train baseline \
+  --folder "$FOLDER" --batchsize 32 --model resnet50 \
+  --lr 0.001 --epochs 100 --out ./runs/baseline "${@:2}"

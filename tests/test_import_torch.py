@@ -1,0 +1,202 @@
+"""torchvision-weight import tests.
+
+1. Primitive-level oracle vs torch (baked-in dependency): conv stride-2
+   pad-1, BN eval semantics, and MaxPool(3,2,1) must match our flax modules
+   bitwise-closely — this is exactly what the explicit-padding change in
+   models/resnet.py guarantees.
+2. Structural round-trip: a synthetic torch state_dict covering every leaf of
+   the flax resnet18/resnet50 trees converts and merges with no unmapped or
+   mismatched leaves.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from ddp_classification_pytorch_tpu.models import resnet as R
+from ddp_classification_pytorch_tpu.models.import_torch import (
+    convert_resnet_state_dict,
+    merge_into_variables,
+)
+
+torch = pytest.importorskip("torch")
+
+
+def test_conv_stride2_matches_torch():
+    tconv = torch.nn.Conv2d(3, 8, 3, stride=2, padding=1, bias=False)
+    x = np.random.default_rng(0).normal(size=(2, 3, 16, 16)).astype(np.float32)
+    with torch.no_grad():
+        ref = tconv(torch.from_numpy(x)).numpy()
+
+    import flax.linen as nn
+
+    fconv = nn.Conv(8, (3, 3), strides=(2, 2), use_bias=False,
+                    padding=[(1, 1), (1, 1)])
+    kernel = tconv.weight.detach().numpy().transpose(2, 3, 1, 0)
+    out = fconv.apply({"params": {"kernel": jnp.asarray(kernel)}},
+                      jnp.asarray(x.transpose(0, 2, 3, 1)))
+    np.testing.assert_allclose(
+        np.asarray(out).transpose(0, 3, 1, 2), ref, atol=1e-5, rtol=1e-5)
+
+
+def test_maxpool_matches_torch():
+    x = np.random.default_rng(1).normal(size=(2, 3, 15, 15)).astype(np.float32)
+    with torch.no_grad():
+        ref = torch.nn.functional.max_pool2d(
+            torch.from_numpy(x), 3, stride=2, padding=1).numpy()
+    import flax.linen as nn
+
+    out = nn.max_pool(jnp.asarray(x.transpose(0, 2, 3, 1)), (3, 3),
+                      strides=(2, 2), padding=[(1, 1), (1, 1)])
+    np.testing.assert_allclose(
+        np.asarray(out).transpose(0, 3, 1, 2), ref, atol=1e-6)
+
+
+def _torch_key_for(flax_path, leaf):
+    """Inverse of import_torch._convert_key, for synthesizing state_dicts."""
+    bn_inv = {"scale": "weight", "bias": "bias", "mean": "running_mean",
+              "var": "running_var"}
+    parts = list(flax_path)
+    if parts[0] == "conv_stem":
+        return "conv1.weight"
+    if parts[0] == "bn_stem":
+        return f"bn1.{bn_inv[leaf]}"
+    if parts[0] == "fc":
+        return f"fc.{'weight' if leaf == 'kernel' else 'bias'}"
+    layer, block = parts[0].split("_block")
+    prefix = f"{layer}.{block}"
+    sub = parts[1]
+    if sub == "downsample_conv":
+        return f"{prefix}.downsample.0.weight"
+    if sub == "downsample_bn":
+        return f"{prefix}.downsample.1.{bn_inv[leaf]}"
+    if sub.startswith("Conv_"):
+        return f"{prefix}.conv{int(sub.split('_')[1]) + 1}.weight"
+    if sub.startswith("BatchNorm_"):
+        return f"{prefix}.bn{int(sub.split('_')[1]) + 1}.{bn_inv[leaf]}"
+    raise AssertionError(flax_path)
+
+
+@pytest.mark.parametrize("arch", ["resnet18", "resnet50"])
+def test_state_dict_roundtrip_covers_every_leaf(arch):
+    model = getattr(R, arch)(num_classes=7, dtype=jnp.float32)
+    variables = model.init(jax.random.PRNGKey(0),
+                           jnp.zeros((1, 64, 64, 3)), train=False)
+
+    rng = np.random.default_rng(2)
+    state_dict = {}
+    expected = {}
+    for coll in ("params", "batch_stats"):
+        flat = jax.tree_util.tree_flatten_with_path(variables[coll])[0]
+        for path, value in flat:
+            names = tuple(p.key for p in path)
+            key = _torch_key_for(names[:-1], names[-1])
+            arr = rng.normal(size=value.shape).astype(np.float32)
+            expected[(coll,) + names] = arr
+            if names[-1] == "kernel" and arr.ndim == 4:
+                state_dict[key] = arr.transpose(3, 2, 0, 1)  # HWIO → OIHW
+            elif names[-1] == "kernel":
+                state_dict[key] = arr.T
+            else:
+                state_dict[key] = arr
+    state_dict["bn1.num_batches_tracked"] = np.int64(5)  # must be skipped
+    state_dict["mean_vector"] = np.zeros(3)  # vestigial buffer, skipped
+
+    converted = convert_resnet_state_dict(state_dict)
+    merged = merge_into_variables(variables, converted)
+    for coll in ("params", "batch_stats"):
+        flat = jax.tree_util.tree_flatten_with_path(merged[coll])[0]
+        for path, value in flat:
+            names = (coll,) + tuple(p.key for p in path)
+            np.testing.assert_array_equal(
+                np.asarray(value), expected[names], err_msg=str(names))
+
+
+def test_pretrained_path_loads_into_train_state(tmp_path):
+    """End to end: torch.save a synthetic torchvision-format checkpoint, point
+    ModelConfig.pretrained_path at it, and verify the backbone picks it up."""
+    from ddp_classification_pytorch_tpu.config import get_preset
+    from ddp_classification_pytorch_tpu.parallel import mesh as meshlib
+    from ddp_classification_pytorch_tpu.train.state import create_train_state
+
+    model = R.resnet18(num_classes=1000, dtype=jnp.float32)
+    variables = model.init(jax.random.PRNGKey(0),
+                           jnp.zeros((1, 64, 64, 3)), train=False)
+    rng = np.random.default_rng(3)
+    state_dict = {}
+    for coll in ("params", "batch_stats"):
+        flat = jax.tree_util.tree_flatten_with_path(variables[coll])[0]
+        for path, value in flat:
+            names = tuple(p.key for p in path)
+            key = _torch_key_for(names[:-1], names[-1])
+            arr = rng.normal(size=value.shape).astype(np.float32)
+            if names[-1] == "kernel" and arr.ndim == 4:
+                state_dict[key] = torch.from_numpy(arr.transpose(3, 2, 0, 1))
+            elif names[-1] == "kernel":
+                state_dict[key] = torch.from_numpy(arr.T)
+            else:
+                state_dict[key] = torch.from_numpy(arr)
+    ckpt = tmp_path / "rn18.pth"
+    torch.save(state_dict, str(ckpt))
+
+    cfg = get_preset("baseline")
+    cfg.model.arch = "resnet18"
+    cfg.model.dtype = "float32"
+    cfg.model.pretrained = True
+    cfg.model.pretrained_path = str(ckpt)
+    cfg.data.image_size = 64
+    cfg.data.num_classes = 10  # != 1000 → fc must be skipped, backbone loaded
+
+    mesh = meshlib.make_mesh()
+    _, _, state = create_train_state(cfg, mesh, steps_per_epoch=4)
+    got = np.asarray(state.params["backbone"]["conv_stem"]["kernel"])
+    want = np.asarray(state_dict["conv1.weight"]).transpose(2, 3, 1, 0)
+    np.testing.assert_allclose(got, want, atol=1e-6)
+
+
+def test_nested_feat_net_format_converts():
+    """Reference NESTED checkpoints key the backbone as feat_net.<seq_idx>.*
+    (NetFeat Sequential over [conv1,bn1,relu,maxpool,layer1..4,avgpool],
+    NESTED/model/model.py:37-40)."""
+    sd = {
+        "feat_net.0.weight": np.zeros((64, 3, 7, 7), np.float32),
+        "feat_net.1.weight": np.ones((64,), np.float32),
+        "feat_net.1.running_mean": np.zeros((64,), np.float32),
+        "feat_net.4.0.conv1.weight": np.zeros((64, 64, 3, 3), np.float32),
+        "feat_net.4.0.bn1.bias": np.zeros((64,), np.float32),
+    }
+    out = convert_resnet_state_dict(sd)
+    assert out["params"]["conv_stem"]["kernel"].shape == (7, 7, 3, 64)
+    assert out["params"]["bn_stem"]["scale"].shape == (64,)
+    assert out["batch_stats"]["bn_stem"]["mean"].shape == (64,)
+    assert out["params"]["layer1_block0"]["Conv_0"]["kernel"].shape == (3, 3, 64, 64)
+    assert out["params"]["layer1_block0"]["BatchNorm_0"]["bias"].shape == (64,)
+
+
+def test_empty_conversion_raises():
+    with pytest.raises(ValueError, match="no convertible"):
+        convert_resnet_state_dict({"encoder.blocks.0.w": np.zeros((3, 3))})
+
+
+def test_pretrained_without_path_raises():
+    from ddp_classification_pytorch_tpu.config import get_preset
+    from ddp_classification_pytorch_tpu.parallel import mesh as meshlib
+    from ddp_classification_pytorch_tpu.train.state import create_train_state
+
+    cfg = get_preset("baseline")
+    cfg.model.arch = "resnet18"
+    cfg.model.pretrained = True  # no pretrained_path
+    cfg.data.image_size = 32
+    with pytest.raises(ValueError, match="pretrained_path"):
+        create_train_state(cfg, meshlib.make_mesh(), steps_per_epoch=1)
+
+
+def test_merge_rejects_shape_mismatch():
+    model = R.resnet18(num_classes=7, dtype=jnp.float32)
+    variables = model.init(jax.random.PRNGKey(0),
+                           jnp.zeros((1, 32, 32, 3)), train=False)
+    bad = {"params": {"conv_stem": {"kernel": np.zeros((3, 3, 3, 63))}}}
+    with pytest.raises(ValueError, match="shape mismatch"):
+        merge_into_variables(variables, bad)

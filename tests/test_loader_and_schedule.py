@@ -136,3 +136,13 @@ def test_warmup_does_not_shift_milestones():
     assert float(sched(15)) == pytest.approx(1.0)     # post-warmup, pre-decay
     assert float(sched(20)) == pytest.approx(0.1)     # first milestone on time
     assert float(sched(40)) == pytest.approx(0.01)    # second milestone on time
+
+
+def test_warmup_rescales_under_grad_accum():
+    # warmup_iters counts ITERATIONS; with accumulation k=2 the schedule
+    # advances once per optimizer step, so warmup spans warmup_iters/k steps
+    cfg = OptimConfig(lr=1.0, schedule="constant", warmup_iters=10,
+                      warmup_start_lr=0.0)
+    sched = build_schedule(cfg, steps_per_epoch=10, grad_accum=2)
+    assert float(sched(4)) == pytest.approx(0.8)   # 4/5 through a 5-step ramp
+    assert float(sched(5)) == pytest.approx(1.0)
