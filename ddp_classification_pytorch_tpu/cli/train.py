@@ -61,6 +61,9 @@ def build_parser() -> argparse.ArgumentParser:
                    "state_dict or NESTED {'feat','cls'} format)")
     m.add_argument("--dtype", default="", help="bfloat16 | float32 compute dtype")
     m.add_argument("--dropout", type=float, default=-1.0)
+    m.add_argument("--remat", action="store_true",
+                   help="rematerialize residual blocks (trade FLOPs for HBM; "
+                   "enables larger global batches)")
 
     o = p.add_argument_group("optimization")
     o.add_argument("--optimizer", default="", help="sgd | adam (arc_main.py:34-43)")
@@ -138,6 +141,15 @@ def config_from_args(args: argparse.Namespace) -> Config:
         cfg.data.val_dir = args.val_dir
     if args.dataset:
         cfg.data.dataset = args.dataset
+        if args.dataset in ("cifar10", "cifar100"):
+            # CIFAR facts override the preset's ImageNet-ish defaults unless
+            # the user explicitly passes the flags
+            if not args.num_classes:
+                cfg.data.num_classes = 10 if args.dataset == "cifar10" else 100
+            if not args.image_size:
+                cfg.data.image_size = 32
+            if not args.variant:
+                cfg.model.variant = "cifar"
     if args.batchsize:
         cfg.data.batch_size = args.batchsize
     if args.num_classes:
@@ -162,6 +174,8 @@ def config_from_args(args: argparse.Namespace) -> Config:
         cfg.model.dtype = args.dtype
     if args.dropout >= 0:
         cfg.model.dropout = args.dropout
+    if args.remat:
+        cfg.model.remat = True
     if args.arc_s >= 0:
         cfg.model.arc_s = args.arc_s
     if args.arc_m >= 0:

@@ -73,6 +73,7 @@ class ModelConfig:
     freeze_bn: bool = False
     dropout: float = 0.0
     dtype: str = "bfloat16"  # compute dtype; params and BN stats stay f32
+    remat: bool = False  # per-block rematerialization (activation-memory lever)
 
 
 @dataclass
@@ -148,6 +149,8 @@ class RunConfig:
     out_dir: str = "./runs/default"
     save_every_epoch: bool = True  # BASELINE/main.py:308-310
     save_best_only: bool = False  # NESTED netBest.pth policy, train.py:154-161
+    async_checkpoint: bool = True  # background serialize+write (SURVEY §5)
+    keep_checkpoints: int = 0  # prune epoch ckpts beyond N (0 = keep all)
     resume: str = ""  # NESTED --resumePth, train.py:372-378
     write_records: bool = True  # output.txt / history.json (SURVEY C23)
     # observability (SURVEY §5 tracing/race-detection rows — the reference has

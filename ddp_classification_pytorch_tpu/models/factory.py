@@ -43,7 +43,7 @@ def build_backbone(cfg: ModelConfig, num_classes: int = 0,
     if cfg.arch in _RESNETS:
         return _RESNETS[cfg.arch](
             num_classes=num_classes, variant=cfg.variant, dtype=dtype,
-            axis_name=axis_name, freeze_bn=cfg.freeze_bn,
+            axis_name=axis_name, freeze_bn=cfg.freeze_bn, remat=cfg.remat,
         )
     if cfg.arch == "vgg19_bn":
         return vgg19_bn(num_classes=num_classes, dtype=dtype,

@@ -113,6 +113,10 @@ class ResNet(nn.Module):
     axis_name: Optional[str] = None
     freeze_bn: bool = False  # NESTED freeze-BN (model/model.py:44-55)
     bn_momentum: float = 0.9  # torch BN momentum 0.1 == flax momentum 0.9
+    # rematerialize residual blocks in the backward pass: trades ~1 extra
+    # forward of FLOPs for O(depth) activation memory — the HBM lever for
+    # large global batches (jax.checkpoint per block)
+    remat: bool = False
 
     @nn.compact
     def __call__(self, x: jnp.ndarray, train: bool = True) -> jnp.ndarray:
@@ -148,10 +152,11 @@ class ResNet(nn.Module):
             # matching torch's border semantics
             x = nn.max_pool(x, (3, 3), strides=(2, 2), padding=[(1, 1), (1, 1)])
 
+        block_cls = nn.remat(self.block_cls) if self.remat else self.block_cls
         for i, n_blocks in enumerate(self.stage_sizes):
             for j in range(n_blocks):
                 strides = 2 if (i > 0 and j == 0) else 1
-                x = self.block_cls(
+                x = block_cls(
                     filters=self.num_filters * (2 ** i),
                     strides=strides, conv=conv, norm=norm,
                     name=f"layer{i + 1}_block{j}",

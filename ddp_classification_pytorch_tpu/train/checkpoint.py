@@ -47,11 +47,18 @@ class CheckpointManager:
         save_every_epoch: bool = True,
         best_only: bool = False,
         keep: int = 0,
+        async_save: bool = False,
     ):
         self.out_dir = out_dir
         self.save_every_epoch = save_every_epoch
         self.best_only = best_only
         self.keep = keep  # 0 = keep all epoch checkpoints
+        # async_save: serialize + write on a background thread so the train
+        # loop keeps dispatching (the preemption-recovery posture SURVEY §5
+        # calls for). device_get happens synchronously (cheap, and required
+        # before the train step mutates the donated buffers).
+        self.async_save = async_save
+        self._pending = None
         self.best_metric = float("-inf")
         if is_host0():
             os.makedirs(out_dir, exist_ok=True)
@@ -70,11 +77,37 @@ class CheckpointManager:
 
     # ----------------------------------------------------------------- save --
     def _write(self, state: Any, path: str) -> None:
-        data = serialization.to_bytes(jax.device_get(state))
-        tmp = path + ".tmp"
-        with open(tmp, "wb") as f:
-            f.write(data)
-        os.replace(tmp, path)  # atomic: no torn checkpoints on preemption
+        self._write_many(state, [path])
+
+    def _write_many(self, state: Any, paths, prune_after: bool = False) -> None:
+        """One device_get + one serialization, written to every path (a
+        new-best epoch writes the same bytes to ckpt_eN and ckpt_best)."""
+        host_state = jax.device_get(state)
+
+        def serialize_and_write():
+            data = serialization.to_bytes(host_state)
+            for path in paths:
+                tmp = path + ".tmp"
+                with open(tmp, "wb") as f:
+                    f.write(data)
+                os.replace(tmp, path)  # atomic: no torn ckpts on preemption
+            if prune_after and self.keep > 0:
+                self._prune()
+
+        if not self.async_save:
+            serialize_and_write()
+            return
+        import threading
+
+        self.wait()  # one in-flight write at a time, in order
+        self._pending = threading.Thread(target=serialize_and_write, daemon=True)
+        self._pending.start()
+
+    def wait(self) -> None:
+        """Block until any in-flight async write has landed."""
+        if self._pending is not None:
+            self._pending.join()
+            self._pending = None
 
     def _write_meta(self, **kw: Any) -> None:
         meta = self.read_meta()
@@ -111,12 +144,14 @@ class CheckpointManager:
             self.best_metric = max(self.best_metric, metric)
         if not is_host0():
             return is_best
+        paths = []
         if self.save_every_epoch and not self.best_only:
-            self._write(state, self.epoch_path(epoch))
-            if self.keep > 0:
-                self._prune(epoch)
+            paths.append(self.epoch_path(epoch))
         if is_best:
-            self._write(state, self.best_path)
+            paths.append(self.best_path)
+        if paths:
+            self._write_many(state, paths, prune_after=True)
+        if is_best:
             self._write_meta(
                 best_epoch=epoch,
                 best_metric=float(metric),
@@ -125,7 +160,7 @@ class CheckpointManager:
         self._write_meta(last_epoch=epoch)
         return is_best
 
-    def _prune(self, current_epoch: int) -> None:
+    def _prune(self) -> None:
         have = sorted(self._epoch_checkpoints())
         for e in have[: max(len(have) - self.keep, 0)]:
             os.remove(self.epoch_path(e))
@@ -148,6 +183,7 @@ class CheckpointManager:
 
     def restore_latest(self, template_state: Any) -> Tuple[Any, int]:
         """(state, next_epoch). next_epoch = 0 when nothing to restore."""
+        self.wait()
         epochs = self._epoch_checkpoints()
         if epochs:
             last = max(epochs)

@@ -65,6 +65,19 @@ def build_datasets(cfg: Config) -> Tuple[Any, Any]:
         val = ImageFolderDataset.from_root(
             d.val_dir or d.train_dir, t_val, d.imgs_per_class, d.max_classes)
         return train, val
+    if d.dataset in ("cifar10", "cifar100"):
+        from ..data.cifar import CIFARDataset
+
+        t_train = build_transform("cifar", train=True, image_size=d.image_size)
+        t_val = build_transform("cifar", train=False, image_size=d.image_size)
+        train = CIFARDataset(d.train_dir, True, t_train, kind=d.dataset)
+        val = CIFARDataset(d.val_dir or d.train_dir, False, t_val, kind=d.dataset)
+        if d.num_classes != train.num_classes:
+            raise ValueError(
+                f"data.num_classes={d.num_classes} but {d.dataset} has "
+                f"{train.num_classes} classes — the CLI sets both defaults "
+                "when --dataset cifar10/cifar100 is passed")
+        return train, val
     if d.dataset == "plc":
         # Clothing1M annotation layout (PLC/FolderDataset.py:9-75):
         # train_dir/val_dir are the data roots; annotations live under
@@ -143,6 +156,8 @@ class Trainer:
             cfg.run.out_dir,
             save_every_epoch=cfg.run.save_every_epoch,
             best_only=cfg.run.save_best_only,
+            keep=cfg.run.keep_checkpoints,
+            async_save=cfg.run.async_checkpoint,
         )
         self.start_epoch = 0
         if cfg.run.resume:
@@ -265,4 +280,5 @@ class Trainer:
             metric = val_m.get("val_top1")
             self.ckpt.save(self.state, epoch, metric=metric,
                            **({"best_k": val_m["best_k"]} if "best_k" in val_m else {}))
+        self.ckpt.wait()  # land any in-flight async checkpoint before returning
         return last

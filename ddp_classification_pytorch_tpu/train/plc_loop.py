@@ -171,4 +171,5 @@ class PLCTrainer(Trainer):
                 self.ckpt._write_meta(plc_delta=float(self.delta))
                 np.save(os.path.join(self.cfg.run.out_dir, "plc_labels.npy"),
                         _dataset_labels(self.train_ds))
+        self.ckpt.wait()
         return last

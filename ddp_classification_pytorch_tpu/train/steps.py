@@ -33,7 +33,7 @@ from ..ops.nested import (
     prefix_mask,
     sample_mask_dims,
 )
-from ..utils.metrics import topk_correct
+from ..utils.metrics import topk_correct, topk_hits
 from .state import TrainState
 
 Batch = Tuple[jnp.ndarray, jnp.ndarray]  # (images NHWC f32, labels i32)
@@ -105,9 +105,7 @@ def make_train_step(
     return jax.jit(step, donate_argnums=0)
 
 
-def _topk_hits(logits: jnp.ndarray, labels: jnp.ndarray, k: int) -> jnp.ndarray:
-    top = jnp.argsort(-logits, axis=-1)[..., :k]
-    return (top == labels[..., None]).any(axis=-1)
+_topk_hits = topk_hits  # rank-count membership, sort-free (utils/metrics.py)
 
 
 def make_eval_step(

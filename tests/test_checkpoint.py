@@ -1,0 +1,68 @@
+"""CheckpointManager unit tests: async writes, pruning, best policy, resume."""
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from ddp_classification_pytorch_tpu.train.checkpoint import CheckpointManager
+from ddp_classification_pytorch_tpu.train.state import TrainState
+
+
+def _state(v: float) -> TrainState:
+    return TrainState(
+        step=jnp.asarray(int(v)),
+        params={"w": jnp.full((4,), v)},
+        batch_stats={"m": jnp.zeros((2,))},
+        opt_state=(),
+    )
+
+
+def test_async_save_and_restore(tmp_path):
+    mgr = CheckpointManager(str(tmp_path), async_save=True)
+    for e in range(3):
+        mgr.save(_state(float(e)), e, metric=float(e))
+    mgr.wait()
+    assert sorted(mgr._epoch_checkpoints()) == [0, 1, 2]
+
+    restored, next_epoch = mgr.restore_latest(_state(-1.0))
+    assert next_epoch == 3
+    np.testing.assert_array_equal(np.asarray(restored.params["w"]), np.full((4,), 2.0))
+    # best tracks the max metric
+    meta = mgr.read_meta()
+    assert meta["best_epoch"] == 2 and meta["best_metric"] == 2.0
+
+
+def test_keep_prunes_old_epochs(tmp_path):
+    mgr = CheckpointManager(str(tmp_path), keep=2)
+    for e in range(5):
+        mgr.save(_state(float(e)), e)
+    mgr.wait()
+    assert sorted(mgr._epoch_checkpoints()) == [3, 4]
+
+
+def test_keep_prunes_under_async(tmp_path):
+    # pruning must run AFTER the in-flight write lands, or retention is keep+1
+    mgr = CheckpointManager(str(tmp_path), keep=2, async_save=True)
+    for e in range(5):
+        mgr.save(_state(float(e)), e)
+    mgr.wait()
+    assert sorted(mgr._epoch_checkpoints()) == [3, 4]
+
+
+def test_best_epoch_writes_identical_bytes_once(tmp_path):
+    mgr = CheckpointManager(str(tmp_path), keep=0)
+    mgr.save(_state(3.0), 0, metric=1.0)  # epoch file AND best in one save
+    mgr.wait()
+    a = (tmp_path / "ckpt_e0.msgpack").read_bytes()
+    b = (tmp_path / "ckpt_best.msgpack").read_bytes()
+    assert a == b and len(a) > 0
+
+
+def test_best_only_policy(tmp_path):
+    mgr = CheckpointManager(str(tmp_path), save_every_epoch=True, best_only=True)
+    assert mgr.save(_state(0.0), 0, metric=0.5) is True
+    assert mgr.save(_state(1.0), 1, metric=0.4) is False  # not a new best
+    mgr.wait()
+    assert mgr._epoch_checkpoints() == []  # best_only: no per-epoch files
+    restored, _ = mgr.restore_latest(_state(-1.0))
+    np.testing.assert_array_equal(np.asarray(restored.params["w"]), np.zeros((4,)))

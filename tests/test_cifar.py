@@ -1,0 +1,50 @@
+"""CIFAR local-pickle dataset tests (synthesized pickle files)."""
+
+import os
+import pickle
+
+import numpy as np
+import pytest
+
+from ddp_classification_pytorch_tpu.data.cifar import CIFARDataset
+from ddp_classification_pytorch_tpu.data.transforms import build_transform
+
+
+@pytest.fixture(scope="module")
+def cifar_root(tmp_path_factory):
+    root = tmp_path_factory.mktemp("cifar") / "cifar-10-batches-py"
+    root.mkdir()
+    rng = np.random.default_rng(0)
+    for i in range(1, 6):
+        data = {
+            "data": rng.integers(0, 256, (20, 3072), dtype=np.int64).astype(np.uint8),
+            "labels": rng.integers(0, 10, 20).tolist(),
+        }
+        with open(root / f"data_batch_{i}", "wb") as f:
+            pickle.dump(data, f)
+    test = {
+        "data": rng.integers(0, 256, (10, 3072), dtype=np.int64).astype(np.uint8),
+        "labels": rng.integers(0, 10, 10).tolist(),
+    }
+    with open(root / "test_batch", "wb") as f:
+        pickle.dump(test, f)
+    return str(root.parent)  # point at the PARENT: _find_root must descend
+
+
+def test_cifar10_loads_and_transforms(cifar_root):
+    t = build_transform("cifar", train=True, image_size=32)
+    ds = CIFARDataset(cifar_root, train=True, transform=t)
+    assert len(ds) == 100
+    img, label = ds.__getitem__(0, np.random.default_rng(1))
+    assert img.shape == (32, 32, 3) and img.dtype == np.float32
+    assert 0 <= label < 10
+
+    val = CIFARDataset(cifar_root, train=False,
+                       transform=build_transform("cifar", train=False, image_size=32))
+    assert len(val) == 10
+
+
+def test_cifar_missing_files_error(tmp_path):
+    t = build_transform("cifar", train=True, image_size=32)
+    with pytest.raises(FileNotFoundError, match="cannot download"):
+        CIFARDataset(str(tmp_path), train=True, transform=t)
