@@ -39,7 +39,8 @@ def build_parser() -> argparse.ArgumentParser:
                    "train/val dirs (reference --folder, BASELINE/main.py:27)")
     d.add_argument("--train_dir", default="", help="explicit train dir (overrides --folder)")
     d.add_argument("--val_dir", default="", help="explicit val dir (overrides --folder)")
-    d.add_argument("--dataset", default="", help="imagefolder | synthetic | plc")
+    d.add_argument("--dataset", default="",
+                   help="imagefolder | synthetic | plc | cifar10 | cifar100")
     d.add_argument("--batchsize", "-b", type=int, default=0,
                    help="PER-HOST batch size; the global batch is "
                    "batchsize × num_hosts (cf. reference per-GPU batch, "

@@ -67,6 +67,10 @@ class ModelConfig:
     arc_m: float = 0.5
     arc_easy_margin: bool = True
     arc_embed_dim: int = 256  # arc_main.py:223-231: 2048->512->256 embedding
+    # reference quirk: arc_main.py:230 appends LogSoftmax to the EMBEDDING
+    # (almost certainly a bug — features are re-normalized in the margin
+    # product); off by default, flag preserves bug-compat training
+    arc_log_softmax_quirk: bool = False
     # Nested dropout (NESTED/train.py:512-530: nested=100 i.e. sigma of the
     # Gaussian over feature dims; freeze_bn=True)
     nested_std: float = 100.0

@@ -101,7 +101,8 @@ def build_model(cfg: ModelConfig, num_classes: int,
     if cfg.head == "arcface":
         return ArcFaceModel(
             backbone=build_backbone(cfg, 0, axis_name),
-            embedding=ArcEmbedding(dims=(512, cfg.arc_embed_dim)),
+            embedding=ArcEmbedding(dims=(512, cfg.arc_embed_dim),
+                                   log_softmax_quirk=cfg.arc_log_softmax_quirk),
             margin=ArcMarginHead(
                 num_classes=num_classes, in_features=cfg.arc_embed_dim,
                 s=cfg.arc_s, m=cfg.arc_m, easy_margin=cfg.arc_easy_margin,

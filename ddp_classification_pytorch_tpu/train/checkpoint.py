@@ -59,6 +59,7 @@ class CheckpointManager:
         # before the train step mutates the donated buffers).
         self.async_save = async_save
         self._pending = None
+        self._pending_error: list = []
         self.best_metric = float("-inf")
         if is_host0():
             os.makedirs(out_dir, exist_ok=True)
@@ -79,9 +80,12 @@ class CheckpointManager:
     def _write(self, state: Any, path: str) -> None:
         self._write_many(state, [path])
 
-    def _write_many(self, state: Any, paths, prune_after: bool = False) -> None:
+    def _write_many(self, state: Any, paths, prune_after: bool = False,
+                    meta_updates: Optional[dict] = None) -> None:
         """One device_get + one serialization, written to every path (a
-        new-best epoch writes the same bytes to ckpt_eN and ckpt_best)."""
+        new-best epoch writes the same bytes to ckpt_eN and ckpt_best).
+        `meta_updates` land AFTER the checkpoint bytes — meta must never
+        point at a checkpoint that has not hit disk yet."""
         host_state = jax.device_get(state)
 
         def serialize_and_write():
@@ -91,6 +95,8 @@ class CheckpointManager:
                 with open(tmp, "wb") as f:
                     f.write(data)
                 os.replace(tmp, path)  # atomic: no torn ckpts on preemption
+            if meta_updates:
+                self._write_meta(**meta_updates)
             if prune_after and self.keep > 0:
                 self._prune()
 
@@ -99,15 +105,28 @@ class CheckpointManager:
             return
         import threading
 
-        self.wait()  # one in-flight write at a time, in order
-        self._pending = threading.Thread(target=serialize_and_write, daemon=True)
+        self.wait()  # one in-flight write at a time, in order; raises if
+        # the previous write failed
+
+        def guarded():
+            try:
+                serialize_and_write()
+            except BaseException as e:  # surfaced by the next wait()
+                self._pending_error.append(e)
+
+        self._pending = threading.Thread(target=guarded, daemon=True)
         self._pending.start()
 
     def wait(self) -> None:
-        """Block until any in-flight async write has landed."""
+        """Block until any in-flight async write has landed; re-raise its
+        failure (a silently lost checkpoint must not look like success)."""
         if self._pending is not None:
             self._pending.join()
             self._pending = None
+        if self._pending_error:
+            err = self._pending_error[0]
+            self._pending_error.clear()
+            raise RuntimeError("async checkpoint write failed") from err
 
     def _write_meta(self, **kw: Any) -> None:
         meta = self.read_meta()
@@ -149,15 +168,20 @@ class CheckpointManager:
             paths.append(self.epoch_path(epoch))
         if is_best:
             paths.append(self.best_path)
-        if paths:
-            self._write_many(state, paths, prune_after=True)
+        meta_updates: dict = {"last_epoch": epoch}
         if is_best:
-            self._write_meta(
+            meta_updates.update(
                 best_epoch=epoch,
                 best_metric=float(metric),
-                **{k: (float(v) if hasattr(v, "__float__") else v) for k, v in extra_meta.items()},
+                **{k: (float(v) if hasattr(v, "__float__") else v)
+                   for k, v in extra_meta.items()},
             )
-        self._write_meta(last_epoch=epoch)
+        if paths:
+            # meta rides with the write so it lands strictly after the bytes
+            self._write_many(state, paths, prune_after=True,
+                             meta_updates=meta_updates)
+        else:
+            self._write_meta(**meta_updates)
         return is_best
 
     def _prune(self) -> None:
@@ -187,6 +211,9 @@ class CheckpointManager:
         epochs = self._epoch_checkpoints()
         if epochs:
             last = max(epochs)
+            # resume best-tracking too, or the first post-resume epoch would
+            # clobber ckpt_best regardless of its metric
+            self.best_metric = self.read_meta().get("best_metric", float("-inf"))
             return self.restore(template_state, self.epoch_path(last)), last + 1
         if os.path.exists(self.best_path):
             meta = self.read_meta()

@@ -166,6 +166,7 @@ class Trainer:
             # previous run's out_dir, not this one's)
             meta = CheckpointManager.meta_for_checkpoint(cfg.run.resume)
             self.start_epoch = int(meta.get("last_epoch", -1)) + 1
+            self.ckpt.best_metric = meta.get("best_metric", float("-inf"))
             host0_print(f"resumed from {cfg.run.resume} at epoch {self.start_epoch}")
 
         host0_print(

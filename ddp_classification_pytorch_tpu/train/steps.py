@@ -105,7 +105,6 @@ def make_train_step(
     return jax.jit(step, donate_argnums=0)
 
 
-_topk_hits = topk_hits  # rank-count membership, sort-free (utils/metrics.py)
 
 
 def make_eval_step(
@@ -131,8 +130,8 @@ def make_eval_step(
             logits.astype(jnp.float32), labels)
         return {
             "loss_sum": (ce * valid).sum(),
-            "top1": (_topk_hits(logits, labels, 1) * valid).sum(),
-            "top3": (_topk_hits(logits, labels, 3) * valid).sum(),
+            "top1": (topk_hits(logits, labels, 1) * valid).sum(),
+            "top3": (topk_hits(logits, labels, 3) * valid).sum(),
             "n": valid.sum(),
         }
 

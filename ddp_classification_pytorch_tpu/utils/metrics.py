@@ -32,7 +32,11 @@ def topk_hits(logits: jnp.ndarray, labels: jnp.ndarray, k: int) -> jnp.ndarray:
     true_logit = jnp.take_along_axis(
         logits, labels[..., None].astype(jnp.int32), axis=-1)
     rank = jnp.sum(logits > true_logit, axis=-1)
-    return rank < k
+    # NaN guard: comparisons with NaN are all False, which would make a
+    # diverged model score rank 0 (= top-1 hit) on every sample; a row with
+    # any non-finite logit is a miss (argsort semantics sorted NaNs last)
+    finite = jnp.all(jnp.isfinite(logits), axis=-1)
+    return (rank < k) & finite
 
 
 def topk_correct(logits: jnp.ndarray, labels: jnp.ndarray, k: int) -> jnp.ndarray:

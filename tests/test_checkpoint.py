@@ -58,6 +58,59 @@ def test_best_epoch_writes_identical_bytes_once(tmp_path):
     assert a == b and len(a) > 0
 
 
+def test_async_write_failure_surfaces(tmp_path):
+    mgr = CheckpointManager(str(tmp_path), async_save=True)
+    mgr.save(_state(0.0), 0)
+    mgr.wait()
+    import os
+    import shutil
+
+    shutil.rmtree(tmp_path)  # make the next write fail
+    mgr.save(_state(1.0), 1)
+    import pytest as _pytest
+
+    with _pytest.raises(RuntimeError, match="async checkpoint write failed"):
+        mgr.wait()
+    os.makedirs(tmp_path, exist_ok=True)
+
+
+def test_meta_lands_after_bytes(tmp_path):
+    # meta.json must not claim an epoch whose checkpoint has not hit disk;
+    # easiest observable: after wait(), both exist and agree
+    mgr = CheckpointManager(str(tmp_path), async_save=True)
+    mgr.save(_state(0.0), 7, metric=0.5)
+    mgr.wait()
+    assert (tmp_path / "ckpt_e7.msgpack").exists()
+    assert mgr.read_meta()["last_epoch"] == 7
+    assert mgr.read_meta()["best_epoch"] == 7
+
+
+def test_resume_restores_best_tracking(tmp_path):
+    mgr = CheckpointManager(str(tmp_path))
+    mgr.save(_state(0.0), 0, metric=0.8)
+    mgr.save(_state(1.0), 1, metric=0.6)
+    mgr.wait()
+
+    mgr2 = CheckpointManager(str(tmp_path))
+    _, next_epoch = mgr2.restore_latest(_state(-1.0))
+    assert next_epoch == 2
+    assert mgr2.best_metric == 0.8
+    # a worse metric after resume must NOT become the new best
+    assert mgr2.save(_state(2.0), 2, metric=0.55) is False
+
+
+def test_nan_logits_are_not_hits():
+    import jax.numpy as jnp
+
+    from ddp_classification_pytorch_tpu.utils.metrics import topk_hits
+
+    logits = jnp.array([[jnp.nan, jnp.nan, jnp.nan], [3.0, 1.0, 0.0]])
+    labels = jnp.array([0, 0])
+    hits = topk_hits(logits, labels, 1)
+    assert not bool(hits[0])  # diverged row is a miss, not a perfect score
+    assert bool(hits[1])
+
+
 def test_best_only_policy(tmp_path):
     mgr = CheckpointManager(str(tmp_path), save_every_epoch=True, best_only=True)
     assert mgr.save(_state(0.0), 0, metric=0.5) is True
