@@ -38,6 +38,10 @@ def main() -> None:
     ap.add_argument("--warmup", type=int, default=10)
     args = ap.parse_args()
 
+    from ddp_classification_pytorch_tpu.utils.cache import enable_persistent_cache
+
+    enable_persistent_cache()  # the driver re-benches every round
+
     from ddp_classification_pytorch_tpu.config import get_preset
     from ddp_classification_pytorch_tpu.parallel import mesh as meshlib
     from ddp_classification_pytorch_tpu.train.state import create_train_state

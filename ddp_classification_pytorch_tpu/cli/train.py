@@ -127,6 +127,14 @@ def build_parser() -> argparse.ArgumentParser:
                      help="model-parallel axis (class-dim sharding of wide heads)")
     par.add_argument("--multihost", action="store_true",
                      help="call jax.distributed.initialize() (TPU pods)")
+
+    compat = p.add_argument_group("reference-CLI compatibility (ignored)")
+    compat.add_argument("--world_size", type=int, default=None,
+                        help="ignored: TPU meshes derive their size from the "
+                        "hardware; parallelism is --dp/--mp")
+    compat.add_argument("--local_rank", type=int, default=None,
+                        help="ignored: no per-device processes on TPU; one "
+                        "process per host sees all local chips")
     return p
 
 
@@ -247,12 +255,18 @@ def config_from_args(args: argparse.Namespace) -> Config:
 
 def main(argv: Optional[Sequence[str]] = None) -> None:
     args = build_parser().parse_args(argv)
+    import jax
+
     if args.platform:
-        import jax
         jax.config.update("jax_platforms", args.platform)
     if args.multihost:
-        import jax
         jax.distributed.initialize()
+    if args.world_size is not None or args.local_rank is not None:
+        print("[compat] --world_size/--local_rank are ignored on TPU: one "
+              "process per host, batch shards over the device mesh")
+    from ..utils.cache import enable_persistent_cache
+
+    enable_persistent_cache()
 
     from ..train.loop import Trainer
     from ..train.plc_loop import PLCTrainer
@@ -261,7 +275,6 @@ def main(argv: Optional[Sequence[str]] = None) -> None:
     cfg = config_from_args(args)
     set_seed(cfg.run.seed)
     if cfg.run.debug_nans:
-        import jax
         jax.config.update("jax_debug_nans", True)
     trainer_cls = PLCTrainer if cfg.workload == "plc" else Trainer
     trainer = trainer_cls(cfg)

@@ -150,6 +150,7 @@ class RunConfig:
     seed: int = 999  # set_seed(999), BASELINE/main.py:43-50
     log_every: int = 20  # BASELINE/main.py:284
     eval_every: int = 1
+    eval_first: bool = False  # initial Test before training (NESTED:413-414)
     out_dir: str = "./runs/default"
     save_every_epoch: bool = True  # BASELINE/main.py:308-310
     save_best_only: bool = False  # NESTED netBest.pth policy, train.py:154-161
@@ -226,6 +227,7 @@ def nested_preset() -> Config:
     cfg.optim.warmup_iters = 10000
     cfg.run.epochs = 50
     cfg.run.save_best_only = True
+    cfg.run.eval_first = True  # initial Test before training (train.py:413-414)
     return cfg
 
 

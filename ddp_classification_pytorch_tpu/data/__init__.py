@@ -1,11 +1,14 @@
 from .imagefolder import ImageFolderDataset, scan_image_folder
 from .synthetic import SyntheticDataset
+from .cifar import CIFARDataset
 from .transforms import TRANSFORM_PRESETS, build_transform
 from .loader import ShardedLoader, shard_indices_for_host
+from .native import NativeBatcher, native_load_batch
 from .plc import PLCDataset
 
 __all__ = [
     "ImageFolderDataset", "scan_image_folder", "SyntheticDataset",
-    "TRANSFORM_PRESETS", "build_transform", "ShardedLoader",
-    "shard_indices_for_host", "PLCDataset",
+    "CIFARDataset", "TRANSFORM_PRESETS", "build_transform", "ShardedLoader",
+    "shard_indices_for_host", "NativeBatcher", "native_load_batch",
+    "PLCDataset",
 ]

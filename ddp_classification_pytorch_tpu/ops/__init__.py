@@ -1,9 +1,26 @@
 from .arcface import arc_margin_logits, arcface_naive_log_logits
-from .nested import gaussian_dist, sample_mask_dims, prefix_mask, nested_all_k_logits
-from .cdr import cdr_gradient_transform
+from .nested import (
+    best_k,
+    gaussian_dist,
+    nested_all_k_counts,
+    nested_all_k_logits,
+    prefix_mask,
+    sample_mask_dims,
+)
+from .cdr import cdr_clip_schedule, cdr_gradient_transform
+from .labelnoise import (
+    eta_approximation,
+    label_noise,
+    lrt_correction,
+    prob_correction,
+)
+from .pallas_kernels import batch_norm_leaky_relu, fused_bn_leaky_relu
 
 __all__ = [
     "arc_margin_logits", "arcface_naive_log_logits",
-    "gaussian_dist", "sample_mask_dims", "prefix_mask", "nested_all_k_logits",
-    "cdr_gradient_transform",
+    "gaussian_dist", "sample_mask_dims", "prefix_mask",
+    "nested_all_k_logits", "nested_all_k_counts", "best_k",
+    "cdr_gradient_transform", "cdr_clip_schedule",
+    "label_noise", "eta_approximation", "lrt_correction", "prob_correction",
+    "batch_norm_leaky_relu", "fused_bn_leaky_relu",
 ]

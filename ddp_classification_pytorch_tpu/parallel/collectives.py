@@ -30,11 +30,15 @@ try:  # jax>=0.8 top-level API; fall back for older jax
 except ImportError:  # pragma: no cover
     from jax.experimental.shard_map import shard_map  # type: ignore
 
+from typing import TYPE_CHECKING
+
 from ..config import Config
 from ..models.factory import build_model
 from ..utils.metrics import topk_hits
 from .mesh import DATA_AXIS
-from ..train.state import TrainState
+
+if TYPE_CHECKING:  # runtime import would be circular (train.state → parallel)
+    from ..train.state import TrainState
 
 
 def build_ddp_model(cfg: Config):

@@ -268,6 +268,10 @@ class Trainer:
         cfg = self.cfg
         eta = EtaLogger(self.steps_per_epoch, cfg.run.epochs, cfg.run.log_every)
         last: Dict[str, float] = {}
+        if cfg.run.eval_first and self.start_epoch == 0:
+            init_m = self.evaluate()
+            host0_print("[initial eval] " +
+                        " ".join(f"{k}={v:.4f}" for k, v in init_m.items()))
         for epoch in range(self.start_epoch, cfg.run.epochs):
             t0 = time.time()
             train_m = self.train_epoch(epoch, eta)

@@ -1,0 +1,30 @@
+"""VGG19-BN end-to-end smoke on the 8-device mesh (the reference's VGG
+wrapper is dead code, NESTED/model/vgg.py — here it is a live arch)."""
+
+import numpy as np
+
+from ddp_classification_pytorch_tpu.config import get_preset
+from ddp_classification_pytorch_tpu.train.loop import Trainer
+
+
+def test_vgg_trains_one_epoch(tmp_path):
+    cfg = get_preset("baseline")
+    cfg.data.dataset = "synthetic"
+    cfg.data.image_size = 32
+    cfg.data.num_classes = 4
+    cfg.data.synthetic_size = 32
+    cfg.data.batch_size = 16
+    cfg.data.num_workers = 1
+    cfg.model.arch = "vgg19_bn"
+    cfg.model.dtype = "float32"
+    cfg.model.dropout = 0.5
+    cfg.run.epochs = 1
+    cfg.run.write_records = False
+    cfg.run.save_every_epoch = False
+    cfg.run.out_dir = str(tmp_path)
+    cfg.run.eval_first = True  # exercised via run() below
+
+    tr = Trainer(cfg)
+    last = tr.run()  # runs initial eval (eval_first), one epoch, final eval
+    assert np.isfinite(last["loss"])
+    assert 0.0 <= last["val_top1"] <= 1.0
