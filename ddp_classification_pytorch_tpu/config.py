@@ -50,7 +50,10 @@ class ModelConfig:
 
     arch covers the reference zoo: torchvision-style ImageNet ResNets
     (NESTED/model/imagenet_resnet.py), CIFAR ResNets
-    (NESTED/model/cifar_resnet.py), VGG19-BN (NESTED/model/vgg.py).
+    (NESTED/model/cifar_resnet.py), VGG19-BN (NESTED/model/vgg.py) — plus the
+    framework's transformer extension (vit_t16/vit_s16/vit_b16, models/vit.py)
+    whose token axis ring-shards over the mesh 'model' axis (long-context
+    sequence parallelism; the reference has no attention, SURVEY §2.2).
     """
 
     arch: str = "resnet50"

@@ -11,6 +11,7 @@ import jax.numpy as jnp
 
 from ..config import ModelConfig
 from . import resnet as _resnet
+from . import vit as _vit
 from .tresnet import tresnet_m
 from .vgg import vgg19_bn
 from .heads import ArcEmbedding, ArcMarginHead, NetClassifier
@@ -33,12 +34,19 @@ def feat_dim_for(cfg: ModelConfig) -> int:
         return 4096
     if cfg.arch in ("tresnet_m", "timm"):
         return 2048
+    if cfg.arch in _vit.FEAT_DIMS:
+        return _vit.FEAT_DIMS[cfg.arch]
     raise ValueError(f"unknown arch {cfg.arch}")
 
 
 def build_backbone(cfg: ModelConfig, num_classes: int = 0,
-                   axis_name: Optional[str] = None) -> nn.Module:
-    """Backbone emitting features (num_classes=0) or logits."""
+                   axis_name: Optional[str] = None,
+                   mesh: Optional[Any] = None) -> nn.Module:
+    """Backbone emitting features (num_classes=0) or logits.
+
+    `mesh` (when its 'model' axis is >1) switches the ViT family to
+    sequence-parallel ring attention with tokens sharded over that axis;
+    the CNN zoos ignore it (their parallelism is batch/class sharding)."""
     dtype = jnp.dtype(cfg.dtype)
     if cfg.arch in _RESNETS:
         return _RESNETS[cfg.arch](
@@ -51,6 +59,16 @@ def build_backbone(cfg: ModelConfig, num_classes: int = 0,
     if cfg.arch in ("tresnet_m", "timm"):
         # reference `--model timm` → tresnet_m_miil_in21k (BASELINE/main.py:141-144)
         return tresnet_m(num_classes=num_classes, dtype=dtype)
+    if cfg.arch in _vit.VIT_CONFIGS:
+        # lazy: parallel/__init__ imports this module (collectives → factory)
+        from ..parallel.mesh import MODEL_AXIS
+
+        seq = MODEL_AXIS if (mesh is not None and mesh.shape.get(MODEL_AXIS, 1) > 1) else None
+        return _vit.build_vit(
+            cfg.arch, num_classes=num_classes, dtype=dtype,
+            dropout=cfg.dropout, mesh=mesh if seq else None, seq_axis=seq,
+            remat=cfg.remat,
+        )
     raise ValueError(f"unknown arch {cfg.arch!r}")
 
 
@@ -95,12 +113,13 @@ class NestedModel(nn.Module):
 
 
 def build_model(cfg: ModelConfig, num_classes: int,
-                axis_name: Optional[str] = None) -> nn.Module:
+                axis_name: Optional[str] = None,
+                mesh: Optional[Any] = None) -> nn.Module:
     if cfg.head == "fc":
-        return ClassifierModel(build_backbone(cfg, num_classes, axis_name))
+        return ClassifierModel(build_backbone(cfg, num_classes, axis_name, mesh))
     if cfg.head == "arcface":
         return ArcFaceModel(
-            backbone=build_backbone(cfg, 0, axis_name),
+            backbone=build_backbone(cfg, 0, axis_name, mesh),
             embedding=ArcEmbedding(dims=(512, cfg.arc_embed_dim),
                                    log_softmax_quirk=cfg.arc_log_softmax_quirk),
             margin=ArcMarginHead(
@@ -110,7 +129,7 @@ def build_model(cfg: ModelConfig, num_classes: int,
         )
     if cfg.head == "nested":
         return NestedModel(
-            backbone=build_backbone(cfg, 0, axis_name),
+            backbone=build_backbone(cfg, 0, axis_name, mesh),
             classifier=NetClassifier(num_classes),
         )
     raise ValueError(f"unknown head {cfg.head!r}")

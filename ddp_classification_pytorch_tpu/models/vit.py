@@ -1,0 +1,134 @@
+"""Flax Vision Transformer backbones with sequence-parallel (ring) attention.
+
+The reference's model zoo is all-convolutional (torchvision/timm backbones at
+BASELINE/main.py:134-144, hand-written ResNets/VGG at NESTED/model/*.py — no
+attention, no sequence axis, SURVEY §2.2). This family is the framework's
+long-context extension: a standard ViT classifier whose token axis can shard
+over the mesh `model` axis, with exact ring attention (ops/attention.py)
+rotating KV shards over ICI. It slots into the same backbone contract as the
+ResNet/VGG zoos — `num_classes=0` → pooled feature vector (the NetFeat role,
+NESTED/model/model.py:12-61), else logits — so every workload head (fc /
+arcface / nested) composes with it unchanged.
+
+TPU-first choices:
+- patch embedding is a stride-`patch` conv → one big MXU matmul;
+- bf16 compute, f32 params / LayerNorm / softmax accumulators;
+- mean-pool over tokens (no CLS token): pooling commutes with the sharded
+  token axis, so the head never needs a gather from shard 0;
+- static shapes end to end; the ring loop is a `lax.fori_loop`.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional, Tuple
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from ..ops.attention import ring_attention
+
+# name → (patch, dim, depth, heads). feat dim == dim (backbone contract).
+VIT_CONFIGS = {
+    "vit_t16": (16, 192, 12, 3),
+    "vit_s16": (16, 384, 12, 6),
+    "vit_b16": (16, 768, 12, 12),
+}
+FEAT_DIMS = {name: dim for name, (_, dim, _, _) in VIT_CONFIGS.items()}
+
+
+class MHA(nn.Module):
+    """Multi-head self-attention over (B, T, C) tokens; ring-parallel when a
+    mesh axis is configured (mesh/seq_axis are static module attrs)."""
+
+    dim: int
+    heads: int
+    dtype: Any = jnp.bfloat16
+    mesh: Optional[Any] = None
+    seq_axis: Optional[str] = None
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
+        b, t, _ = x.shape
+        d = self.dim // self.heads
+        qkv = nn.Dense(3 * self.dim, dtype=self.dtype, name="qkv")(x)
+        qkv = qkv.reshape(b, t, 3, self.heads, d)
+        q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+        out = ring_attention(q, k, v, mesh=self.mesh, axis_name=self.seq_axis)
+        out = out.reshape(b, t, self.dim)
+        return nn.Dense(self.dim, dtype=self.dtype, name="proj")(out)
+
+
+class Block(nn.Module):
+    """Pre-LN transformer block: LN→MHA→res, LN→MLP(4×, GELU)→res."""
+
+    dim: int
+    heads: int
+    dtype: Any = jnp.bfloat16
+    dropout: float = 0.0
+    mesh: Optional[Any] = None
+    seq_axis: Optional[str] = None
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray, train: bool = True) -> jnp.ndarray:
+        y = nn.LayerNorm(dtype=jnp.float32, name="ln1")(x).astype(self.dtype)
+        x = x + MHA(self.dim, self.heads, self.dtype, self.mesh,
+                    self.seq_axis, name="attn")(y)
+        y = nn.LayerNorm(dtype=jnp.float32, name="ln2")(x).astype(self.dtype)
+        y = nn.Dense(4 * self.dim, dtype=self.dtype, name="mlp_in")(y)
+        y = nn.gelu(y)
+        if self.dropout:
+            y = nn.Dropout(self.dropout, deterministic=not train)(y)
+        y = nn.Dense(self.dim, dtype=self.dtype, name="mlp_out")(y)
+        return x + y
+
+
+class ViT(nn.Module):
+    """ViT backbone → pooled feature (num_classes=0) or logits.
+
+    `seq_axis` + `mesh` switch every attention layer to ring attention with
+    tokens sharded over that mesh axis. Token count (image_size/patch)² must
+    then be divisible by the axis size.
+    """
+
+    patch: int = 16
+    dim: int = 384
+    depth: int = 12
+    heads: int = 6
+    num_classes: int = 0
+    dtype: Any = jnp.bfloat16
+    dropout: float = 0.0
+    mesh: Optional[Any] = None
+    seq_axis: Optional[str] = None
+    remat: bool = False
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray, train: bool = True) -> jnp.ndarray:
+        x = x.astype(self.dtype)
+        x = nn.Conv(self.dim, (self.patch, self.patch),
+                    strides=(self.patch, self.patch), padding="VALID",
+                    dtype=self.dtype, name="patch_embed")(x)
+        b, h, w, c = x.shape
+        x = x.reshape(b, h * w, c)
+        pos = self.param("pos_embed",
+                         nn.initializers.normal(stddev=0.02),
+                         (1, h * w, self.dim), jnp.float32)
+        x = x + pos.astype(self.dtype)
+        block_cls = nn.remat(Block, static_argnums=(2,)) if self.remat else Block
+        for i in range(self.depth):
+            x = block_cls(self.dim, self.heads, self.dtype, self.dropout,
+                          self.mesh, self.seq_axis, name=f"block{i}")(x, train)
+        x = nn.LayerNorm(dtype=jnp.float32, name="ln_final")(x)
+        x = x.mean(axis=1)  # token mean-pool; shard-friendly (see module doc)
+        x = x.astype(jnp.float32)
+        if self.num_classes > 0:
+            x = nn.Dense(self.num_classes, dtype=jnp.float32, name="fc")(x)
+        return x
+
+
+def build_vit(arch: str, num_classes: int = 0, dtype: Any = jnp.bfloat16,
+              dropout: float = 0.0, mesh: Optional[Any] = None,
+              seq_axis: Optional[str] = None, remat: bool = False) -> ViT:
+    patch, dim, depth, heads = VIT_CONFIGS[arch]
+    return ViT(patch=patch, dim=dim, depth=depth, heads=heads,
+               num_classes=num_classes, dtype=dtype, dropout=dropout,
+               mesh=mesh, seq_axis=seq_axis, remat=remat)

@@ -1,0 +1,174 @@
+"""Attention ops — dense single-device and ring (sequence-parallel) variants.
+
+The reference is all-CNN: no attention, no sequence axis anywhere in its tree
+(SURVEY §2.2 — `NESTED/model/*.py`, backbones at `BASELINE/main.py:134-144`),
+so its only "big dimension" is the class dim, which this framework already
+shards over the mesh `model` axis (parallel/mesh.py). This module supplies the
+genuine long-context mechanism on top of that: exact ring attention, so the
+transformer backbone family (models/vit.py) can shard the TOKEN axis across
+chips and scale sequence length past one chip's HBM.
+
+How it works (TPU-first, not a translation of any GPU kernel):
+
+- Q/K/V are sharded on the sequence axis over a mesh axis. Each device holds
+  (B, T/N, H, D) shards.
+- Every device computes blockwise attention of its Q shard against the KV
+  shard it currently holds, then passes the KV shard to its ring neighbor via
+  `jax.lax.ppermute` — N steps visit every KV block. The permute rides ICI
+  neighbor links; XLA overlaps the transfer with the current block's compute.
+- Softmax is accumulated online across blocks with the usual running
+  (max m, normalizer l, output o) rescaling, in f32, so the result is EXACT
+  dense attention — same FLOPs, O(T/N) activation memory per device.
+- Static control flow (`lax.fori_loop` over a compile-time ring size), static
+  shapes, MXU-shaped einsums with f32 accumulation via
+  `preferred_element_type`.
+
+`ring_attention` degrades to the dense op when the mesh axis is absent or has
+size 1, so model code calls one function unconditionally.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+from ..utils.compat import shard_map_unchecked
+
+# Finite stand-in for -inf: keeps exp()/max() arithmetic NaN-free when a
+# whole block is masked out (causal ring steps where the visiting KV block
+# lies entirely in the query's future).
+_NEG_INF = -1e30
+
+
+def attention(
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    causal: bool = False,
+    scale: Optional[float] = None,
+) -> jnp.ndarray:
+    """Dense scaled-dot-product attention.
+
+    q, k, v: (B, T, H, D). Returns (B, T, H, D) in q.dtype. Softmax in f32.
+    """
+    if scale is None:
+        scale = q.shape[-1] ** -0.5
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k,
+                   preferred_element_type=jnp.float32) * scale
+    if causal:
+        tq, tk = s.shape[-2], s.shape[-1]
+        mask = jnp.arange(tk)[None, :] <= jnp.arange(tq)[:, None]
+        s = jnp.where(mask, s, _NEG_INF)
+    p = jax.nn.softmax(s, axis=-1)
+    out = jnp.einsum("bhqk,bkhd->bqhd", p.astype(v.dtype), v,
+                     preferred_element_type=jnp.float32)
+    return out.astype(q.dtype)
+
+
+def _block_update(q, k, v, m, l, o, scale, mask=None):
+    """One online-softmax accumulation step against a KV block.
+
+    q: (B,Tq,H,D); k,v: (B,Tk,H,D); m,l: (B,H,Tq) f32; o: (B,Tq,H,D) f32.
+    mask: optional (Tq, Tk) bool, True = attend.
+    """
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k,
+                   preferred_element_type=jnp.float32) * scale
+    if mask is not None:
+        s = jnp.where(mask, s, _NEG_INF)
+    m_new = jnp.maximum(m, s.max(axis=-1))
+    corr = jnp.exp(m - m_new)                      # (B,H,Tq)
+    p = jnp.exp(s - m_new[..., None])              # f32 (B,H,Tq,Tk)
+    l_new = l * corr + p.sum(axis=-1)
+    pv = jnp.einsum("bhqk,bkhd->bqhd", p.astype(v.dtype), v,
+                    preferred_element_type=jnp.float32)
+    o_new = o * corr.transpose(0, 2, 1)[..., None] + pv
+    return m_new, l_new, o_new
+
+
+def _ring_shard(q, k, v, *, axis_name: str, axis_size: int, causal: bool,
+                scale: float):
+    """Per-shard body (inside shard_map): N-step ring over KV shards."""
+    b, t_local, h, d = q.shape
+    rank = jax.lax.axis_index(axis_name)
+    perm = [(i, (i + 1) % axis_size) for i in range(axis_size)]
+
+    m = jnp.full((b, h, t_local), _NEG_INF, jnp.float32)
+    l = jnp.zeros((b, h, t_local), jnp.float32)
+    o = jnp.zeros((b, t_local, h, d), jnp.float32)
+
+    def mask_for(step):
+        if not causal:
+            return None
+        # After `step` permutes, the KV block this rank holds originated at
+        # rank (rank - step) mod N; global token positions decide the causal
+        # mask exactly as in the dense op.
+        src = (rank - step) % axis_size
+        q_pos = rank * t_local + jnp.arange(t_local)
+        k_pos = src * t_local + jnp.arange(t_local)
+        return k_pos[None, :] <= q_pos[:, None]
+
+    def body(step, carry):
+        kb, vb, m, l, o = carry
+        m, l, o = _block_update(q, kb, vb, m, l, o, scale, mask_for(step))
+        kb = jax.lax.ppermute(kb, axis_name, perm)
+        vb = jax.lax.ppermute(vb, axis_name, perm)
+        return kb, vb, m, l, o
+
+    # N-1 update+rotate rounds, then the last visiting block updates outside
+    # the loop — no wasted final ppermute pair (the rotated shards would be
+    # discarded, but a collective inside the loop body cannot be DCE'd).
+    kb, vb, m, l, o = jax.lax.fori_loop(0, axis_size - 1, body, (k, v, m, l, o))
+    m, l, o = _block_update(q, kb, vb, m, l, o, scale, mask_for(axis_size - 1))
+    # Rows with l == 0 cannot occur: step 0 processes the local (diagonal)
+    # block, whose self position is always unmasked.
+    return (o / l.transpose(0, 2, 1)[..., None]).astype(q.dtype)
+
+
+def ring_attention(
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    mesh: Optional[Mesh] = None,
+    axis_name: Optional[str] = None,
+    causal: bool = False,
+    scale: Optional[float] = None,
+) -> jnp.ndarray:
+    """Exact attention with the sequence axis sharded over `axis_name`.
+
+    q, k, v: (B, T, H, D) with T divisible by the axis size. Falls back to
+    the dense op when no mesh/axis is given or the axis has size 1 — model
+    code calls this unconditionally and the single-chip path stays a single
+    fused XLA computation.
+    """
+    if scale is None:
+        scale = q.shape[-1] ** -0.5
+    n = mesh.shape[axis_name] if (mesh is not None and axis_name) else 1
+    if n <= 1:
+        return attention(q, k, v, causal=causal, scale=scale)
+    t = q.shape[1]
+    if t % n:
+        raise ValueError(
+            f"sequence length {t} not divisible by ring size {n} "
+            f"(mesh axis {axis_name!r})")
+    body = functools.partial(
+        _ring_shard, axis_name=axis_name, axis_size=n, causal=causal,
+        scale=scale)
+    # Batch dim shards over every OTHER >1 mesh axis (the 'data' axis in this
+    # framework's meshes): the ring body is batch-local, and leaving the batch
+    # unsharded would replicate the full global batch's attention onto every
+    # device — axis_size× redundant FLOPs/memory in the O(T²) hot path.
+    # Skipped when the batch doesn't divide those axes (e.g. the 2-sample
+    # dummy batch of model.init) — correctness never depends on it.
+    batch_axes = tuple(
+        a for a in mesh.axis_names if a != axis_name and mesh.shape[a] > 1)
+    if batch_axes and q.shape[0] % functools.reduce(
+            lambda s, a: s * mesh.shape[a], batch_axes, 1):
+        batch_axes = ()
+    spec = P(batch_axes if batch_axes else None, axis_name, None, None)
+    f = shard_map_unchecked(
+        body, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec)
+    return f(q, k, v)

@@ -25,12 +25,9 @@ import jax.numpy as jnp
 import optax
 from jax.sharding import PartitionSpec as P
 
-try:  # jax>=0.8 top-level API; fall back for older jax
-    from jax import shard_map  # type: ignore
-except ImportError:  # pragma: no cover
-    from jax.experimental.shard_map import shard_map  # type: ignore
-
 from typing import TYPE_CHECKING
+
+from ..utils.compat import shard_map_unchecked
 
 from ..config import Config
 from ..models.factory import build_model
@@ -97,12 +94,9 @@ def make_shard_map_train_step(
         return new_state, metrics
 
     # replication checking can't prove the in-shard optimizer update is
-    # replicated (it is, by construction: pmean'd grads); disable it under
-    # either API spelling (check_rep pre-0.8, check_vma 0.8+)
-    kwargs = dict(mesh=mesh, in_specs=(P(), P(DATA_AXIS), P(DATA_AXIS)),
-                  out_specs=(P(), P()))
-    try:
-        sharded = shard_map(per_shard, check_vma=False, **kwargs)
-    except TypeError:
-        sharded = shard_map(per_shard, check_rep=False, **kwargs)
+    # replicated (it is, by construction: pmean'd grads); shard_map_unchecked
+    # disables it under either API spelling (check_rep pre-0.8, check_vma 0.8+)
+    sharded = shard_map_unchecked(
+        per_shard, mesh=mesh, in_specs=(P(), P(DATA_AXIS), P(DATA_AXIS)),
+        out_specs=(P(), P()))
     return jax.jit(sharded, donate_argnums=0)
