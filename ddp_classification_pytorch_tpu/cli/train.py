@@ -108,6 +108,12 @@ def build_parser() -> argparse.ArgumentParser:
     r.add_argument("--seed", type=int, default=-1)
     r.add_argument("--out", default="", help="output dir (records + checkpoints)")
     r.add_argument("--resume", default="", help="checkpoint path to resume from")
+    r.add_argument("--auto_resume", action="store_true",
+                   help="resume from the latest checkpoint in --out if any "
+                        "(preemption recovery; see scripts/supervise.sh)")
+    r.add_argument("--tensorboard", action="store_true",
+                   help="write TensorBoard event files to <out>/tb "
+                        "(dependency-free writer, utils/tensorboard.py)")
     r.add_argument("--log_every", type=int, default=0)
     r.add_argument("--save_best_only", action="store_true")
     r.add_argument("--profile_steps", type=int, default=0,
@@ -224,6 +230,10 @@ def config_from_args(args: argparse.Namespace) -> Config:
         cfg.run.out_dir = args.out
     if args.resume or args.resumePth:
         cfg.run.resume = args.resume or args.resumePth
+    if args.auto_resume:
+        cfg.run.auto_resume = True
+    if args.tensorboard:
+        cfg.run.tensorboard = True
     if args.log_every:
         cfg.run.log_every = args.log_every
     if args.save_best_only:

@@ -160,7 +160,15 @@ class RunConfig:
     async_checkpoint: bool = True  # background serialize+write (SURVEY §5)
     keep_checkpoints: int = 0  # prune epoch ckpts beyond N (0 = keep all)
     resume: str = ""  # NESTED --resumePth, train.py:372-378
+    # preemption recovery (SURVEY §5 failure-detection row): pick up the
+    # latest checkpoint in out_dir automatically — the restart command is
+    # then identical to the start command (scripts/supervise.sh relies on it)
+    auto_resume: bool = False
     write_records: bool = True  # output.txt / history.json (SURVEY C23)
+    # TensorBoard event files at <out_dir>/tb (utils/tensorboard.py, no deps).
+    # The reference only ever carried commented-out tensorboardX imports
+    # (BASELINE/main.py:41-42,311)
+    tensorboard: bool = False
     # observability (SURVEY §5 tracing/race-detection rows — the reference has
     # ad-hoc wall-clock timers only)
     profile_steps: int = 0  # >0: capture a jax.profiler trace of steps [10, 10+N)

@@ -110,3 +110,18 @@ def param_shardings(variables: Any, mesh: Mesh) -> Any:
         for path, value in flat
     ]
     return jax.tree_util.tree_unflatten(treedef, specs)
+
+
+def opt_shardings(opt_state: Any, mesh: Mesh) -> Any:
+    """NamedSharding pytree for an optax state.
+
+    jit(tx.init) does NOT propagate parameter shardings into the momentum
+    tree (outputs land on one device), so optimizer state gets explicit
+    shardings: momentum/trace subtrees mirror the parameter tree's key paths,
+    so the same `_spec_for_param` rules apply — class-sharded weights get
+    class-sharded momentum, everything else replicates. Without this, a
+    restored state (device_put onto the template's shardings) mixes
+    single-device opt leaves with mesh-wide params and jit rejects the step.
+    """
+    # momentum key paths embed the param key paths, so the param rules apply
+    return param_shardings(opt_state, mesh)

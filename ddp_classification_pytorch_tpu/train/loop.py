@@ -25,6 +25,7 @@ TPU-first details the reference has no analogue for:
 
 from __future__ import annotations
 
+import os
 import time
 from typing import Any, Dict, Optional, Tuple
 
@@ -39,7 +40,7 @@ from ..data.synthetic import SyntheticDataset
 from ..data.transforms import build_transform
 from ..ops.nested import best_k
 from ..parallel import mesh as meshlib
-from ..utils.logging import EtaLogger, RecordWriter, host0_print
+from ..utils.logging import EtaLogger, RecordWriter, host0_print, is_host0
 from .checkpoint import CheckpointManager
 from .state import create_train_state, param_count
 from .steps import make_eval_step, make_nested_eval_step, make_train_step
@@ -152,6 +153,11 @@ class Trainer:
 
         self._setup_profiler()
         self.records = RecordWriter(cfg.run.out_dir) if cfg.run.write_records else None
+        self.tb = None
+        if cfg.run.tensorboard and is_host0():
+            from ..utils.tensorboard import SummaryWriter
+
+            self.tb = SummaryWriter(os.path.join(cfg.run.out_dir, "tb"))
         self.ckpt = CheckpointManager(
             cfg.run.out_dir,
             save_every_epoch=cfg.run.save_every_epoch,
@@ -168,6 +174,13 @@ class Trainer:
             self.start_epoch = int(meta.get("last_epoch", -1)) + 1
             self.ckpt.best_metric = meta.get("best_metric", float("-inf"))
             host0_print(f"resumed from {cfg.run.resume} at epoch {self.start_epoch}")
+        elif cfg.run.auto_resume:
+            # preemption recovery: restart command == start command; fresh
+            # runs fall through with start_epoch 0 (nothing in out_dir yet)
+            self.state, self.start_epoch = self.ckpt.restore_latest(self.state)
+            if self.start_epoch:
+                host0_print(
+                    f"auto-resumed from {cfg.run.out_dir} at epoch {self.start_epoch}")
 
         host0_print(
             f"[trainer] workload={cfg.workload} arch={cfg.model.arch} "
@@ -282,8 +295,15 @@ class Trainer:
             )
             if self.records is not None:
                 self.records.log_epoch(epoch, **{k: v for k, v in last.items()})
+            if self.tb is not None:
+                for k, v in last.items():
+                    group = "val" if k.startswith("val_") else "train"
+                    self.tb.add_scalar(f"{group}/{k}", v, epoch)
+                self.tb.flush()
             metric = val_m.get("val_top1")
             self.ckpt.save(self.state, epoch, metric=metric,
                            **({"best_k": val_m["best_k"]} if "best_k" in val_m else {}))
         self.ckpt.wait()  # land any in-flight async checkpoint before returning
+        if self.tb is not None:
+            self.tb.close()
         return last

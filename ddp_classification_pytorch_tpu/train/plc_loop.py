@@ -57,18 +57,26 @@ class PLCTrainer(Trainer):
             cfg, self.model, batch_stat_mode=cfg.plc.batch_stat_predictions)
         self.delta = cfg.plc.current_delta
         self.corrections_per_epoch: list = []
+        resume_dir = ""
         if cfg.run.resume:
+            resume_dir = os.path.dirname(os.path.abspath(cfg.run.resume))
+        elif cfg.run.auto_resume and self.start_epoch:
+            resume_dir = cfg.run.out_dir  # Trainer already restored the state
+        if resume_dir:
             # corrected labels + carried δ are training state too — restore
             # them or the resumed run silently reverts to the noisy labels
             from .checkpoint import CheckpointManager
 
-            meta = CheckpointManager.meta_for_checkpoint(cfg.run.resume)
+            meta = CheckpointManager.read_meta_at(
+                os.path.join(resume_dir, "meta.json"))
             self.delta = float(meta.get("plc_delta", self.delta))
-            labels_path = os.path.join(
-                os.path.dirname(os.path.abspath(cfg.run.resume)), "plc_labels.npy")
+            labels_path = os.path.join(resume_dir, "plc_labels.npy")
             if os.path.exists(labels_path):
                 _set_dataset_labels(self.train_ds, np.load(labels_path))
                 host0_print(f"[plc] restored corrected labels from {labels_path}")
+                # the restored array already reflects the original injection
+                # plus every correction epoch — re-injecting would clobber it
+                return
         if cfg.plc.noise_type >= 0:
             if eta is None:
                 raise ValueError("synthetic noise injection requires an eta matrix")
@@ -165,6 +173,12 @@ class PLCTrainer(Trainer):
                         " ".join(f"{k}={v:.4f}" for k, v in last.items()))
             if self.records is not None:
                 self.records.log_epoch(epoch, **last)
+            if self.tb is not None:
+                for k, v in last.items():
+                    group = "val" if k.startswith("val_") else (
+                        "plc" if k in ("corrected", "delta") else "train")
+                    self.tb.add_scalar(f"{group}/{k}", v, epoch)
+                self.tb.flush()
             self.ckpt.save(self.state, epoch, metric=val_m.get("val_top1"))
             if is_host0():
                 # persist correction state next to the checkpoints
@@ -172,4 +186,6 @@ class PLCTrainer(Trainer):
                 np.save(os.path.join(self.cfg.run.out_dir, "plc_labels.npy"),
                         _dataset_labels(self.train_ds))
         self.ckpt.wait()
+        if self.tb is not None:
+            self.tb.close()
         return last

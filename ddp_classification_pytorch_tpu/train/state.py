@@ -82,8 +82,12 @@ def create_train_state(
 
     params = jax.device_put(params, meshlib.param_shardings(params, mesh))
     batch_stats = jax.device_put(batch_stats, meshlib.replicated(mesh))
-    # jit propagates param shardings into zeros_like momentum leaves
+    # jit does NOT propagate param shardings into the momentum leaves (they
+    # land on one device); re-place them under the explicit rules so the
+    # whole state carries NamedShardings — required for restore, where leaves
+    # are device_put onto the template's shardings (parallel/mesh.py)
     opt_state = jax.jit(tx.init)(params)
+    opt_state = jax.device_put(opt_state, meshlib.opt_shardings(opt_state, mesh))
 
     state = TrainState(
         step=jax.device_put(jnp.zeros((), jnp.int32), meshlib.replicated(mesh)),

@@ -1,0 +1,24 @@
+#!/usr/bin/env bash
+# Failure-detection / preemption-recovery supervisor (SURVEY §5: the reference
+# has none — a crashed torch.distributed.launch rank hangs the others at the
+# next collective, BASELINE/train.sh:1). This wrapper restarts the trainer
+# with --auto_resume until it exits cleanly or retries are exhausted; the
+# restart command is identical to the start command because auto-resume picks
+# up the latest checkpoint in --out.
+#
+# Usage: MAX_RESTARTS=5 bash scripts/supervise.sh <workload> --out runs/x [flags...]
+set -u
+max=${MAX_RESTARTS:-5}
+n=0
+while true; do
+  python -m ddp_classification_pytorch_tpu.cli.train "$@" --auto_resume
+  rc=$?
+  [ "$rc" -eq 0 ] && exit 0
+  n=$((n + 1))
+  if [ "$n" -gt "$max" ]; then
+    echo "[supervise] giving up after $n failures (last rc=$rc)" >&2
+    exit "$rc"
+  fi
+  echo "[supervise] trainer exited rc=$rc; restart $n/$max (auto-resume)" >&2
+  sleep 2
+done

@@ -107,3 +107,24 @@ def test_noise_injection_at_init(tmp_path):
     eta /= eta.sum(1, keepdims=True)
     tr = PLCTrainer(cfg, train_ds, val_ds, eta=eta)
     assert int((np.asarray(train_ds.labels) != clean).sum()) > 0
+
+
+def test_plc_auto_resume_restores_labels_and_delta(tmp_path):
+    """Preemption recovery for the PLC workload: --auto_resume must carry the
+    corrected labels and δ across the restart, not just the model state."""
+    cfg = _tiny_cfg(tmp_path, epochs=1)
+    cfg.run.save_every_epoch = True
+    cfg.run.auto_resume = True
+
+    train_ds = SyntheticDataset(128, 32, 4, seed=999)
+    val_ds = SyntheticDataset(32, 32, 4, seed=999, item_offset=128)
+    tr = PLCTrainer(cfg, train_ds, val_ds)
+    tr.delta = 0.37  # distinguishable carried state
+    tr.run()
+    labels_after = np.asarray(train_ds.labels).copy()
+    delta_after = tr.delta
+
+    tr2 = PLCTrainer(cfg, SyntheticDataset(128, 32, 4, seed=999), val_ds)
+    assert tr2.start_epoch == 1
+    assert tr2.delta == delta_after
+    np.testing.assert_array_equal(np.asarray(tr2.train_ds.labels), labels_after)
