@@ -130,7 +130,12 @@ def build_parser() -> argparse.ArgumentParser:
     par.add_argument("--dp", type=int, default=0,
                      help="data-parallel mesh axis size (0 = all devices)")
     par.add_argument("--mp", type=int, default=0,
-                     help="model-parallel axis (class-dim sharding of wide heads)")
+                     help="model-parallel axis (class-dim sharding of wide "
+                          "heads; ring-attention seq sharding for ViT; "
+                          "pipeline stages with --pp_microbatches)")
+    par.add_argument("--pp_microbatches", type=int, default=0,
+                     help="enable GPipe pipelining of the ViT block stack "
+                          "over the model axis with N microbatches")
     par.add_argument("--multihost", action="store_true",
                      help="call jax.distributed.initialize() (TPU pods)")
 
@@ -260,6 +265,8 @@ def config_from_args(args: argparse.Namespace) -> Config:
         cfg.parallel.data_axis = args.dp
     if args.mp:
         cfg.parallel.model_axis = args.mp
+    if args.pp_microbatches:
+        cfg.parallel.pipeline_microbatches = args.pp_microbatches
     return cfg
 
 

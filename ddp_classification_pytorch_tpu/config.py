@@ -123,6 +123,11 @@ class ParallelConfig:
     model_axis: int = 1
     # microbatching / grad accumulation (capability headroom; reference: none)
     grad_accum: int = 1
+    # >0 enables GPipe pipeline parallelism for the ViT family: the block
+    # stack shards into model_axis stages and this many microbatches stream
+    # through them (ops/pipeline.py). The model axis serves one role per
+    # config: class-TP | ring-attention SP | PP.
+    pipeline_microbatches: int = 0
 
 
 @dataclass

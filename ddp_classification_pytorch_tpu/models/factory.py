@@ -114,7 +114,29 @@ class NestedModel(nn.Module):
 
 def build_model(cfg: ModelConfig, num_classes: int,
                 axis_name: Optional[str] = None,
-                mesh: Optional[Any] = None) -> nn.Module:
+                mesh: Optional[Any] = None,
+                pipeline_microbatches: int = 0) -> Any:
+    if pipeline_microbatches > 0:
+        from ..parallel.mesh import MODEL_AXIS
+        from .pipeline_vit import GPipeViT
+
+        if cfg.arch not in _vit.VIT_CONFIGS:
+            raise ValueError(
+                f"pipeline parallelism (--pp_microbatches) requires a ViT "
+                f"arch with a homogeneous block stack; got {cfg.arch!r}")
+        if mesh is None:
+            raise ValueError("pipeline parallelism requires a device mesh")
+        if cfg.head != "fc":
+            raise ValueError(
+                f"pipeline parallelism only supports head='fc' "
+                f"(got {cfg.head!r})")
+        if cfg.dropout:
+            raise ValueError(
+                "pipeline parallelism does not support dropout (the tick "
+                "loop carries no per-tick rng); set --dropout 0")
+        return GPipeViT(
+            cfg.arch, num_classes, mesh, pipeline_microbatches,
+            dtype=jnp.dtype(cfg.dtype), axis_name=MODEL_AXIS, remat=cfg.remat)
     if cfg.head == "fc":
         return ClassifierModel(build_backbone(cfg, num_classes, axis_name, mesh))
     if cfg.head == "arcface":

@@ -1,0 +1,104 @@
+"""Pipeline-parallel ViT: homogeneous transformer blocks over the GPipe
+executor (ops/pipeline.py), stage-sharded on the mesh `model` axis.
+
+The reference has no pipeline parallelism — or any model this deep — so this
+is framework headroom, not parity (SURVEY §2.2). The CNN zoos don't pipeline
+well (heterogeneous stages); the ViT's depth axis is homogeneous, which is
+exactly what the single-SPMD-program pipeline needs.
+
+Not a flax module: parameters are explicit pytrees and `init`/`apply` match
+the framework's model contract (train/state.py, train/steps.py — the flax
+calling convention), while block parameters themselves come from the SAME
+flax `Block` used by the dense/ring ViT (models/vit.py), vmapped over depth.
+One `model` axis serves ONE role per configuration: class-dim TP (heads),
+sequence-parallel ring attention (models/vit.py), or pipeline stages (here).
+
+Microbatch count and stage count are configuration (`--pp_microbatches`,
+mesh `model` axis size); depth % stages == 0 and
+batch % (microbatches × data-axis) == 0 are validated by the executor.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..ops.pipeline import gpipe
+from .vit import VIT_CONFIGS, Block
+
+
+class GPipeViT:
+    """ViT classifier with its block stack pipelined over the mesh."""
+
+    def __init__(self, arch: str, num_classes: int, mesh: Any,
+                 microbatches: int, dtype: Any = jnp.bfloat16,
+                 axis_name: str = "model", remat: bool = False):
+        self.patch, self.dim, self.depth, self.heads = VIT_CONFIGS[arch]
+        self.num_classes = num_classes
+        self.mesh = mesh
+        self.microbatches = microbatches
+        self.dtype = dtype
+        self.axis_name = axis_name
+        # dropout stays 0 in the pipelined path: the tick loop would need
+        # per-tick rng plumbing for no parity gain (reference has no ViT)
+        self._block = Block(self.dim, self.heads, dtype, 0.0, None, None)
+        apply_fn = lambda p, h: self._block.apply({"params": p}, h, True)  # noqa: E731
+        self._block_apply = jax.checkpoint(apply_fn) if remat else apply_fn
+
+    # ------------------------------------------------------------------ init --
+    def init(self, rngs: Any, x: jnp.ndarray, train: bool = False,
+             **_: Any) -> Dict[str, Any]:
+        key = rngs["params"] if isinstance(rngs, dict) else rngs
+        k_patch, k_pos, k_blocks, k_fc = jax.random.split(key, 4)
+        t = (x.shape[1] // self.patch) * (x.shape[2] // self.patch)
+        dummy = jnp.zeros((1, t, self.dim), self.dtype)
+        block_params = jax.vmap(
+            lambda k: self._block.init(k, dummy, True)["params"]
+        )(jax.random.split(k_blocks, self.depth))
+        scale = jax.nn.initializers.variance_scaling(1.0, "fan_in", "truncated_normal")
+        params = {
+            "patch": {
+                "kernel": scale(k_patch, (self.patch, self.patch, 3, self.dim),
+                                jnp.float32),
+                "bias": jnp.zeros((self.dim,), jnp.float32),
+            },
+            "pos_embed": 0.02 * jax.random.normal(k_pos, (1, t, self.dim),
+                                                  jnp.float32),
+            "blocks": block_params,
+            "ln_f": {"scale": jnp.ones((self.dim,), jnp.float32),
+                     "bias": jnp.zeros((self.dim,), jnp.float32)},
+            "fc": {"kernel": scale(k_fc, (self.dim, self.num_classes),
+                                   jnp.float32),
+                   "bias": jnp.zeros((self.num_classes,), jnp.float32)},
+        }
+        return {"params": params}
+
+    # ----------------------------------------------------------------- apply --
+    def apply(self, variables: Dict[str, Any], x: jnp.ndarray,
+              train: bool = True, mutable: Optional[Any] = None,
+              rngs: Optional[Any] = None, **_: Any):
+        p = variables["params"]
+        h = jax.lax.conv_general_dilated(
+            x.astype(self.dtype), p["patch"]["kernel"].astype(self.dtype),
+            window_strides=(self.patch, self.patch), padding="VALID",
+            dimension_numbers=("NHWC", "HWIO", "NHWC"))
+        h = h + p["patch"]["bias"].astype(self.dtype)
+        b, hh, ww, c = h.shape
+        h = h.reshape(b, hh * ww, c) + p["pos_embed"].astype(self.dtype)
+
+        h = gpipe(self._block_apply, p["blocks"], h, mesh=self.mesh,
+                  axis_name=self.axis_name, microbatches=self.microbatches)
+
+        # final LN in f32, token mean-pool, linear head (models/vit.py layout)
+        h32 = h.astype(jnp.float32)
+        mu = h32.mean(axis=-1, keepdims=True)
+        var = ((h32 - mu) ** 2).mean(axis=-1, keepdims=True)
+        h32 = (h32 - mu) * jax.lax.rsqrt(var + 1e-6)
+        h32 = h32 * p["ln_f"]["scale"] + p["ln_f"]["bias"]
+        feats = h32.mean(axis=1)
+        logits = feats @ p["fc"]["kernel"] + p["fc"]["bias"]
+        if mutable is not None:
+            return logits, {}
+        return logits

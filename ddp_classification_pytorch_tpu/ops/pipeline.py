@@ -1,0 +1,126 @@
+"""GPipe-style pipeline parallelism over a mesh axis.
+
+The reference has no pipeline parallelism (SURVEY §2.2: DP is its only
+strategy); this module adds the remaining classic axis for the framework's
+transformer family. Stages are consecutive groups of homogeneous blocks whose
+stacked parameters shard over the mesh `model` axis; microbatches stream
+through the stage ring:
+
+    tick t: every stage applies its blocks to the microbatch it holds, then
+    `ppermute`s the activation to the next stage (ICI neighbor link). Stage 0
+    injects microbatch t while t < M; stage S-1 collects an output from tick
+    S-1 on. M + S - 1 ticks drain the pipe; bubble fraction (S-1)/(M+S-1).
+
+TPU-first mechanics:
+- `lax.scan` over ticks and over the blocks within a stage — static control
+  flow, one compiled tick body regardless of M.
+- stage-local compute is the SAME function for every stage (homogeneous
+  blocks), so one SPMD program serves all stages — no per-stage programs.
+- `ppermute` destinations omit stage 0 (perm [(i, i+1)]), whose input is the
+  injected microbatch; XLA's CollectivePermute yields zeros for unaddressed
+  destinations, which the stage-0 `where` discards.
+- outputs live on the last stage only; one `psum` over the axis republishes
+  them (check_vma off — value equality is by construction).
+- reverse-mode AD flows through scan/ppermute/psum, so the SAME executor
+  serves the train step; wrap `block_apply` in `jax.checkpoint` upstream to
+  bound scan residual memory.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable, Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+from ..utils.compat import shard_map_unchecked
+
+
+def _stage_apply(block_apply: Callable, stage_params: Any, x: jnp.ndarray):
+    """Apply this stage's block stack (leading dim = blocks-per-stage)."""
+
+    def body(h, block_params):
+        return block_apply(block_params, h), None
+
+    h, _ = jax.lax.scan(body, x, stage_params)
+    return h
+
+
+def gpipe(
+    block_apply: Callable[[Any, jnp.ndarray], jnp.ndarray],
+    stacked_params: Any,
+    x: jnp.ndarray,
+    *,
+    mesh: Mesh,
+    axis_name: str,
+    microbatches: int,
+) -> jnp.ndarray:
+    """Run `x` (B, T, C) through L stacked blocks, pipelined over `axis_name`.
+
+    stacked_params: pytree whose leaves have leading dim L (one entry per
+    block, in depth order). L must divide by the stage count S (= axis size);
+    stage i owns blocks [i·L/S, (i+1)·L/S). B must divide by
+    `microbatches` × (product of the other >1 mesh axes).
+    """
+    s_count = mesh.shape[axis_name]
+    if s_count <= 1:  # degenerate: plain sequential scan over all blocks
+        return _stage_apply(block_apply, stacked_params, x)
+
+    depth = jax.tree_util.tree_leaves(stacked_params)[0].shape[0]
+    if depth % s_count:
+        raise ValueError(f"depth {depth} not divisible by {s_count} stages")
+    m = microbatches
+
+    batch_axes = tuple(
+        a for a in mesh.axis_names if a != axis_name and mesh.shape[a] > 1)
+    dp = functools.reduce(lambda acc, a: acc * mesh.shape[a], batch_axes, 1)
+    if x.shape[0] % (m * dp):
+        raise ValueError(
+            f"batch {x.shape[0]} not divisible by microbatches×data "
+            f"({m}×{dp})")
+
+    # (L, ...) → (S, L/S, ...): dim 0 shards over the stage axis
+    staged = jax.tree_util.tree_map(
+        lambda p: p.reshape(s_count, depth // s_count, *p.shape[1:]),
+        stacked_params)
+
+    def shard_body(params, x_local):
+        # params: (1, L/S, ...) — this stage's slice; x_local: (B/dp, T, C)
+        params = jax.tree_util.tree_map(lambda p: p[0], params)
+        stage = jax.lax.axis_index(axis_name)
+        b_local, t_len, ch = x_local.shape
+        mbs = x_local.reshape(m, b_local // m, t_len, ch)
+        perm = [(i, i + 1) for i in range(s_count - 1)]
+
+        def tick(carry, t):
+            buf, outs = carry
+            inject = jax.lax.dynamic_index_in_dim(
+                mbs, jnp.clip(t, 0, m - 1), 0, keepdims=False)
+            x_in = jnp.where(stage == 0, inject, buf)
+            y = _stage_apply(block_apply, params, x_in)
+            # last stage stores microbatch t-(S-1) while it is in range
+            w = t - (s_count - 1)
+            is_write = (stage == s_count - 1) & (w >= 0) & (w < m)
+            wc = jnp.clip(w, 0, m - 1)
+            cur = jax.lax.dynamic_index_in_dim(outs, wc, 0, keepdims=False)
+            outs = jax.lax.dynamic_update_index_in_dim(
+                outs, jnp.where(is_write, y, cur), wc, 0)
+            buf = jax.lax.ppermute(y, axis_name, perm)  # stage 0 gets zeros
+            return (buf, outs), None
+
+        buf0 = jnp.zeros_like(mbs[0])
+        outs0 = jnp.zeros_like(mbs)
+        (_, outs), _ = jax.lax.scan(
+            tick, (buf0, outs0), jnp.arange(m + s_count - 1))
+        # republish from the last stage to the whole axis
+        outs = jnp.where(stage == s_count - 1, outs, 0.0)
+        outs = jax.lax.psum(outs, axis_name)
+        return outs.reshape(b_local, t_len, ch)
+
+    p_spec = jax.tree_util.tree_map(lambda _: P(axis_name), staged)
+    x_spec = P(batch_axes if batch_axes else None, None, None)
+    f = shard_map_unchecked(
+        shard_body, mesh=mesh, in_specs=(p_spec, x_spec), out_specs=x_spec)
+    return f(staged, x)

@@ -98,6 +98,10 @@ def _spec_for_param(path: str, value: Any, model_axis_size: int) -> P:
     if value.ndim == 2 and "kernel" in path and (
             "classifier" in path or "']['fc']" in path):
         return P(None, MODEL_AXIS)
+    if ("['blocks']" in path and value.ndim >= 1
+            and value.shape[0] % model_axis_size == 0):
+        # GPipeViT stacked block params (L, ...): depth dim → pipeline stages
+        return P(MODEL_AXIS)
     return P()
 
 

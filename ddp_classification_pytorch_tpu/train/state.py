@@ -51,7 +51,8 @@ def create_train_state(
     *under jit* so XLA propagates the parameter shardings into the momentum
     tree — no hand-written opt-state sharding rules.
     """
-    model = build_model(cfg.model, cfg.data.num_classes, mesh=mesh)
+    model = build_model(cfg.model, cfg.data.num_classes, mesh=mesh,
+                        pipeline_microbatches=cfg.parallel.pipeline_microbatches)
     if rng is None:
         rng = jax.random.PRNGKey(cfg.run.seed)
     p_rng, d_rng = jax.random.split(rng)
