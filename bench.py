@@ -47,7 +47,27 @@ def main() -> None:
     from ddp_classification_pytorch_tpu.train.state import create_train_state
     from ddp_classification_pytorch_tpu.train.steps import make_train_step
 
-    devices = jax.devices()
+    # The tunneled TPU backend can be transiently UNAVAILABLE (lease churn);
+    # retry init with backoff rather than dying on the first probe. The last
+    # attempt re-raises immediately (no trailing sleep), and backends are
+    # cleared between tries — jax caches partially-initialized backends, and
+    # without the clear a retry could silently return the cached CPU client
+    # and emit a bogus images/sec/chip line.
+    attempts = 5
+    for attempt in range(attempts):
+        try:
+            devices = jax.devices()
+            break
+        except RuntimeError as e:
+            if attempt == attempts - 1:
+                raise
+            print(f"# backend init failed (attempt {attempt + 1}/{attempts}): {e}",
+                  file=sys.stderr)
+            try:
+                jax.extend.backend.clear_backends()
+            except Exception:
+                pass
+            time.sleep(30 * (attempt + 1))
     n_chips = len(devices)
     platform = devices[0].platform
     on_accel = platform in ("tpu", "gpu")
