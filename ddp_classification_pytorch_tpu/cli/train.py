@@ -53,7 +53,11 @@ def build_parser() -> argparse.ArgumentParser:
 
     m = p.add_argument_group("model")
     m.add_argument("--model", "--arch", dest="model", default="",
-                   help="resnet18/34/50/101/152 | vgg19_bn (reference --model)")
+                   help="resnet18/34/50/101/152 | vgg19_bn | tresnet_m | "
+                        "vit_t16/s16/b16 (reference --model + extensions)")
+    m.add_argument("--flash_attention", action="store_true",
+                   help="ViT: Pallas streaming attention kernel for the "
+                        "unsharded path")
     m.add_argument("--variant", default="", help="imagenet | cifar stem")
     m.add_argument("--pretrained", action="store_true",
                    help="load converted torchvision weights")
@@ -136,6 +140,9 @@ def build_parser() -> argparse.ArgumentParser:
     par.add_argument("--pp_microbatches", type=int, default=0,
                      help="enable GPipe pipelining of the ViT block stack "
                           "over the model axis with N microbatches")
+    par.add_argument("--dcn_slices", type=int, default=0,
+                     help="multi-slice pods: two-tier mesh with DP across "
+                          "N DCN-connected slices, model axis on ICI")
     par.add_argument("--multihost", action="store_true",
                      help="call jax.distributed.initialize() (TPU pods)")
 
@@ -183,6 +190,8 @@ def config_from_args(args: argparse.Namespace) -> Config:
 
     if args.model:
         cfg.model.arch = args.model
+    if args.flash_attention:
+        cfg.model.flash_attention = True
     if args.variant:
         cfg.model.variant = args.variant
     if args.pretrained:
@@ -267,6 +276,8 @@ def config_from_args(args: argparse.Namespace) -> Config:
         cfg.parallel.model_axis = args.mp
     if args.pp_microbatches:
         cfg.parallel.pipeline_microbatches = args.pp_microbatches
+    if args.dcn_slices:
+        cfg.parallel.dcn_slices = args.dcn_slices
     return cfg
 
 

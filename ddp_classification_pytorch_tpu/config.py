@@ -81,6 +81,10 @@ class ModelConfig:
     dropout: float = 0.0
     dtype: str = "bfloat16"  # compute dtype; params and BN stats stay f32
     remat: bool = False  # per-block rematerialization (activation-memory lever)
+    # ViT family: use the Pallas streaming flash-attention kernel for the
+    # unsharded attention path (ops/flash_attention.py); ring-sharded
+    # attention ignores it
+    flash_attention: bool = False
 
 
 @dataclass
@@ -128,6 +132,10 @@ class ParallelConfig:
     # through them (ops/pipeline.py). The model axis serves one role per
     # config: class-TP | ring-attention SP | PP.
     pipeline_microbatches: int = 0
+    # multi-slice deployments: number of DCN-connected slices. >0 builds a
+    # two-tier mesh (parallel/mesh.py::make_hybrid_mesh) — DP spans slices
+    # (one DCN allreduce/step), model axis stays inside a slice on ICI.
+    dcn_slices: int = 0
 
 
 @dataclass

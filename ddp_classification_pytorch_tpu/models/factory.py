@@ -67,7 +67,7 @@ def build_backbone(cfg: ModelConfig, num_classes: int = 0,
         return _vit.build_vit(
             cfg.arch, num_classes=num_classes, dtype=dtype,
             dropout=cfg.dropout, mesh=mesh if seq else None, seq_axis=seq,
-            remat=cfg.remat,
+            remat=cfg.remat, use_flash=cfg.flash_attention,
         )
     raise ValueError(f"unknown arch {cfg.arch!r}")
 

@@ -38,13 +38,16 @@ FEAT_DIMS = {name: dim for name, (_, dim, _, _) in VIT_CONFIGS.items()}
 
 class MHA(nn.Module):
     """Multi-head self-attention over (B, T, C) tokens; ring-parallel when a
-    mesh axis is configured (mesh/seq_axis are static module attrs)."""
+    mesh axis is configured (mesh/seq_axis are static module attrs);
+    `use_flash` switches the unsharded path to the Pallas streaming kernel
+    (ops/flash_attention.py)."""
 
     dim: int
     heads: int
     dtype: Any = jnp.bfloat16
     mesh: Optional[Any] = None
     seq_axis: Optional[str] = None
+    use_flash: bool = False
 
     @nn.compact
     def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
@@ -53,7 +56,15 @@ class MHA(nn.Module):
         qkv = nn.Dense(3 * self.dim, dtype=self.dtype, name="qkv")(x)
         qkv = qkv.reshape(b, t, 3, self.heads, d)
         q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
-        out = ring_attention(q, k, v, mesh=self.mesh, axis_name=self.seq_axis)
+        ring = (self.mesh is not None and self.seq_axis
+                and self.mesh.shape[self.seq_axis] > 1)
+        if self.use_flash and not ring:
+            from ..ops.flash_attention import flash_attention
+
+            out = flash_attention(q, k, v)
+        else:
+            out = ring_attention(q, k, v, mesh=self.mesh,
+                                 axis_name=self.seq_axis)
         out = out.reshape(b, t, self.dim)
         return nn.Dense(self.dim, dtype=self.dtype, name="proj")(out)
 
@@ -67,12 +78,13 @@ class Block(nn.Module):
     dropout: float = 0.0
     mesh: Optional[Any] = None
     seq_axis: Optional[str] = None
+    use_flash: bool = False
 
     @nn.compact
     def __call__(self, x: jnp.ndarray, train: bool = True) -> jnp.ndarray:
         y = nn.LayerNorm(dtype=jnp.float32, name="ln1")(x).astype(self.dtype)
         x = x + MHA(self.dim, self.heads, self.dtype, self.mesh,
-                    self.seq_axis, name="attn")(y)
+                    self.seq_axis, self.use_flash, name="attn")(y)
         y = nn.LayerNorm(dtype=jnp.float32, name="ln2")(x).astype(self.dtype)
         y = nn.Dense(4 * self.dim, dtype=self.dtype, name="mlp_in")(y)
         y = nn.gelu(y)
@@ -100,6 +112,7 @@ class ViT(nn.Module):
     mesh: Optional[Any] = None
     seq_axis: Optional[str] = None
     remat: bool = False
+    use_flash: bool = False
 
     @nn.compact
     def __call__(self, x: jnp.ndarray, train: bool = True) -> jnp.ndarray:
@@ -116,7 +129,8 @@ class ViT(nn.Module):
         block_cls = nn.remat(Block, static_argnums=(2,)) if self.remat else Block
         for i in range(self.depth):
             x = block_cls(self.dim, self.heads, self.dtype, self.dropout,
-                          self.mesh, self.seq_axis, name=f"block{i}")(x, train)
+                          self.mesh, self.seq_axis, self.use_flash,
+                          name=f"block{i}")(x, train)
         x = nn.LayerNorm(dtype=jnp.float32, name="ln_final")(x)
         x = x.mean(axis=1)  # token mean-pool; shard-friendly (see module doc)
         x = x.astype(jnp.float32)
@@ -127,8 +141,10 @@ class ViT(nn.Module):
 
 def build_vit(arch: str, num_classes: int = 0, dtype: Any = jnp.bfloat16,
               dropout: float = 0.0, mesh: Optional[Any] = None,
-              seq_axis: Optional[str] = None, remat: bool = False) -> ViT:
+              seq_axis: Optional[str] = None, remat: bool = False,
+              use_flash: bool = False) -> ViT:
     patch, dim, depth, heads = VIT_CONFIGS[arch]
     return ViT(patch=patch, dim=dim, depth=depth, heads=heads,
                num_classes=num_classes, dtype=dtype, dropout=dropout,
-               mesh=mesh, seq_axis=seq_axis, remat=remat)
+               mesh=mesh, seq_axis=seq_axis, remat=remat,
+               use_flash=use_flash)

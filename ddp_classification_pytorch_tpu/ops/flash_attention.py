@@ -1,0 +1,150 @@
+"""Pallas TPU flash-attention forward kernel.
+
+The reference has no attention at all (SURVEY §2.2); this kernel serves the
+framework's transformer/long-context extension (models/vit.py,
+ops/attention.py). Motivation: dense attention materializes the (T, T) score
+matrix in HBM; this kernel streams K/V blocks through VMEM and keeps the
+online-softmax accumulators on-chip, so the forward pass reads/writes only
+O(T·D) from HBM — the standard flash-attention memory shape, here expressed
+the Pallas/Mosaic way (same conventions as ops/pallas_kernels.py, the
+repo's TPU-proven kernel):
+
+- grid over (batch·heads, T/block_q); each step owns one q block in VMEM and
+  loops over K/V blocks with `lax.fori_loop` (static trip count);
+- softmax statistics (running max m, normalizer l) carried as (block_q, 128)
+  lane-replicated f32 tiles — the TPU-friendly layout for per-row scalars;
+- QK^T and PV on the MXU with f32 accumulation (`preferred_element_type`);
+- CPU/tests run the same kernel in interpret mode.
+
+Backward: `jax.custom_vjp` recomputing the dense reference
+(ops/attention.py::attention) — exact gradients (test-pinned), O(T²) memory
+in the backward only. A flash backward kernel is the natural next step; the
+public entry point keeps its signature either way.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+_LANES = 128
+_NEG_INF = -1e30
+
+
+def _interpret() -> bool:
+    return jax.default_backend() != "tpu"
+
+
+def _block(t: int) -> int:
+    for b in (1024, 512, 256, 128):
+        if t % b == 0:
+            return b
+    return t  # small/odd T: single block (VMEM easily holds it)
+
+
+def _flash_kernel(q_ref, k_ref, v_ref, o_ref, m_scr, l_scr, acc_scr, *,
+                  scale, nk):
+    """One (batch·head, q-block, kv-block) grid step.
+
+    The kv axis is the LAST grid dimension — sequential on TPU — so the
+    online-softmax accumulators persist in VMEM scratch across kv steps and
+    only one (block_k, D) K/V tile is resident at a time: max sequence
+    length is HBM-bound, not VMEM-bound."""
+    kk = pl.program_id(2)
+    q = q_ref[0].astype(jnp.float32)            # (bq, D)
+    bq, d = q.shape
+
+    @pl.when(kk == 0)
+    def _init():
+        m_scr[:] = jnp.full((bq, _LANES), _NEG_INF, jnp.float32)
+        l_scr[:] = jnp.zeros((bq, _LANES), jnp.float32)
+        acc_scr[:] = jnp.zeros((bq, d), jnp.float32)
+
+    kb = k_ref[0].astype(jnp.float32)           # (bk, D)
+    vb = v_ref[0].astype(jnp.float32)
+    s = jax.lax.dot_general(
+        q, kb, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32) * scale              # (bq, bk)
+    m = m_scr[:]
+    m_cur = jnp.max(s, axis=-1, keepdims=True)                   # (bq, 1)
+    m_new = jnp.maximum(m, jnp.broadcast_to(m_cur, (bq, _LANES)))
+    corr = jnp.exp(m - m_new)                                    # (bq, LANES)
+    p = jnp.exp(s - m_new[:, :1])                                # (bq, bk)
+    l_new = l_scr[:] * corr + jnp.broadcast_to(
+        jnp.sum(p, axis=-1, keepdims=True), (bq, _LANES))
+    pv = jax.lax.dot_general(
+        p, vb, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)                      # (bq, D)
+    acc_new = acc_scr[:] * corr[:, :1] + pv
+    m_scr[:] = m_new
+    l_scr[:] = l_new
+    acc_scr[:] = acc_new
+
+    @pl.when(kk == nk - 1)
+    def _write():
+        o_ref[0] = (acc_new / l_new[:, :1]).astype(o_ref.dtype)
+
+
+def _flash_forward(q3, k3, v3, scale):
+    bh, t, d = q3.shape
+    bq = _block(t)
+    bk = _block(t)
+    grid = (bh, t // bq, t // bk)
+    return pl.pallas_call(
+        functools.partial(_flash_kernel, scale=scale, nk=t // bk),
+        out_shape=jax.ShapeDtypeStruct((bh, t, d), q3.dtype),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, bq, d), lambda i, j, kk: (i, j, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, bk, d), lambda i, j, kk: (i, kk, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, bk, d), lambda i, j, kk: (i, kk, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((1, bq, d), lambda i, j, kk: (i, j, 0),
+                               memory_space=pltpu.VMEM),
+        scratch_shapes=[
+            pltpu.VMEM((bq, _LANES), jnp.float32),   # running max m
+            pltpu.VMEM((bq, _LANES), jnp.float32),   # normalizer l
+            pltpu.VMEM((bq, d), jnp.float32),        # output accumulator
+        ],
+        interpret=_interpret(),
+    )(q3, k3, v3)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3,))
+def flash_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
+                    scale: Optional[float] = None) -> jnp.ndarray:
+    """Bidirectional attention, (B, T, H, D) → (B, T, H, D).
+
+    Forward is the Pallas streaming kernel; gradients recompute the dense
+    reference (exact — see module docstring).
+    """
+    if scale is None:
+        scale = q.shape[-1] ** -0.5
+    b, t, h, d = q.shape
+    to3 = lambda x: x.transpose(0, 2, 1, 3).reshape(b * h, t, d)  # noqa: E731
+    out = _flash_forward(to3(q), to3(k), to3(v), scale)
+    return out.reshape(b, h, t, d).transpose(0, 2, 1, 3)
+
+
+def _fa_fwd(q, k, v, scale):
+    return flash_attention(q, k, v, scale), (q, k, v)
+
+
+def _fa_bwd(scale, res, g):
+    from .attention import attention  # the framework's dense reference op
+
+    q, k, v = res
+    s = scale if scale is not None else q.shape[-1] ** -0.5
+    _, vjp = jax.vjp(lambda q, k, v: attention(q, k, v, scale=s), q, k, v)
+    return vjp(g)
+
+
+flash_attention.defvjp(_fa_fwd, _fa_bwd)

@@ -51,7 +51,70 @@ class MeshSpec:
 def make_mesh(spec: MeshSpec = MeshSpec(), devices: Optional[Sequence[Any]] = None) -> Mesh:
     devices = list(devices) if devices is not None else jax.devices()
     dp, mp = spec.resolve(len(devices))
+    if mp > 1:
+        # ICI-aware layout: contiguous (ring-neighbor) device groups on the
+        # model axis, so ppermute rings (ring attention, GPipe handoffs) and
+        # TP collectives ride ICI neighbor links instead of striding the
+        # torus. Falls back to the trivial reshape off-TPU.
+        try:
+            from jax.experimental import mesh_utils
+
+            arr = mesh_utils.create_device_mesh((dp, mp), devices=devices)
+            return Mesh(arr, (DATA_AXIS, MODEL_AXIS))
+        except Exception:
+            pass
     arr = np.asarray(devices).reshape(dp, mp)
+    return Mesh(arr, (DATA_AXIS, MODEL_AXIS))
+
+
+def make_hybrid_mesh(spec: MeshSpec = MeshSpec(), *,
+                     dcn_data_parallel: int = 0) -> Mesh:
+    """Multi-slice mesh: data parallelism split across DCN-connected slices,
+    model axis kept inside a slice (ICI).
+
+    On a multi-slice TPU deployment (e.g. 2× v5e-256), collectives between
+    slices cross DCN — orders of magnitude slower than ICI — so the only
+    axis that should span slices is pure-DP gradient averaging (one
+    allreduce per step), while TP/SP/PP rings stay intra-slice. This is the
+    standard two-tier layout `mesh_utils.create_hybrid_device_mesh` encodes;
+    the reference's NCCL backend has no equivalent concept (its multi-node
+    path is broken anyway — SURVEY §2.2 rank bug).
+
+    dcn_data_parallel: number of slices (0 = infer from
+    jax.devices()' slice_index when present, else 1 → plain make_mesh).
+    """
+    devices = jax.devices()
+    n_slices = dcn_data_parallel
+    if not n_slices:
+        slice_ids = {getattr(d, "slice_index", 0) for d in devices}
+        n_slices = len(slice_ids)
+    if n_slices <= 1:
+        return make_mesh(spec, devices)
+    from jax.experimental import mesh_utils
+
+    per_slice = len(devices) // n_slices
+    dp_ici, mp = MeshSpec(
+        spec.data_parallel // n_slices if spec.data_parallel else 0,
+        spec.model_parallel).resolve(per_slice)
+    try:
+        arr = mesh_utils.create_hybrid_device_mesh(
+            (dp_ici, mp), (n_slices, 1), devices=devices)
+    except ValueError:
+        if any(hasattr(d, "slice_index") for d in devices):
+            # real multi-slice hardware: a layout error here means the
+            # requested slice count doesn't match the machine — falling
+            # back silently would put rings/TP on DCN, the exact failure
+            # this flag exists to prevent
+            raise
+        # CPU/simulated devices carry no slice topology; keep the same
+        # two-tier LOGICAL layout (slice-major data axis) with a plain
+        # reshape — the virtual-mesh tests exercise this path
+        arr = np.asarray(devices).reshape(n_slices, dp_ici, mp).reshape(
+            n_slices * dp_ici, mp)
+    # Resulting shape is (n_slices·dp_ici, mp): the two DP tiers flatten
+    # into one 'data' axis — shardings stay identical to the single-slice
+    # case; XLA routes the gradient allreduce hierarchically (ICI within a
+    # slice, DCN across)
     return Mesh(arr, (DATA_AXIS, MODEL_AXIS))
 
 

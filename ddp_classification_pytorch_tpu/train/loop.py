@@ -118,8 +118,14 @@ class Trainer:
             train_ds, val_ds = build_datasets(cfg)
         self.train_ds, self.val_ds = train_ds, val_ds
 
-        self.mesh = mesh if mesh is not None else meshlib.make_mesh(
-            meshlib.MeshSpec(cfg.parallel.data_axis, cfg.parallel.model_axis))
+        spec = meshlib.MeshSpec(cfg.parallel.data_axis, cfg.parallel.model_axis)
+        if mesh is not None:
+            self.mesh = mesh
+        elif cfg.parallel.dcn_slices:
+            self.mesh = meshlib.make_hybrid_mesh(
+                spec, dcn_data_parallel=cfg.parallel.dcn_slices)
+        else:
+            self.mesh = meshlib.make_mesh(spec)
 
         train_batcher = val_batcher = None
         if (cfg.data.native_loader and cfg.data.dataset == "imagefolder"
