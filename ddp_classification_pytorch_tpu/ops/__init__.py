@@ -7,7 +7,10 @@ from .nested import (
     prefix_mask,
     sample_mask_dims,
 )
+from .attention import attention, ring_attention
 from .cdr import cdr_clip_schedule, cdr_gradient_transform
+from .flash_attention import flash_attention
+from .pipeline import gpipe
 from .labelnoise import (
     eta_approximation,
     label_noise,
@@ -17,6 +20,7 @@ from .labelnoise import (
 from .pallas_kernels import batch_norm_leaky_relu, fused_bn_leaky_relu
 
 __all__ = [
+    "attention", "ring_attention", "flash_attention", "gpipe",
     "arc_margin_logits", "arcface_naive_log_logits",
     "gaussian_dist", "sample_mask_dims", "prefix_mask",
     "nested_all_k_logits", "nested_all_k_counts", "best_k",
