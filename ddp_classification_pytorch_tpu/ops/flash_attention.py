@@ -1,25 +1,33 @@
-"""Pallas TPU flash-attention forward kernel.
+"""Pallas TPU flash attention — forward AND backward kernels.
 
 The reference has no attention at all (SURVEY §2.2); this kernel serves the
 framework's transformer/long-context extension (models/vit.py,
 ops/attention.py). Motivation: dense attention materializes the (T, T) score
-matrix in HBM; this kernel streams K/V blocks through VMEM and keeps the
-online-softmax accumulators on-chip, so the forward pass reads/writes only
-O(T·D) from HBM — the standard flash-attention memory shape, here expressed
-the Pallas/Mosaic way (same conventions as ops/pallas_kernels.py, the
-repo's TPU-proven kernel):
+matrix in HBM; these kernels stream K/V blocks through VMEM and keep the
+softmax statistics on-chip, so BOTH passes read/write only O(T·D) from HBM —
+the standard flash-attention memory shape, expressed the Pallas/Mosaic way
+(same conventions as ops/pallas_kernels.py, the repo's TPU-proven kernel):
 
-- grid over (batch·heads, T/block_q); each step owns one q block in VMEM and
-  loops over K/V blocks with `lax.fori_loop` (static trip count);
-- softmax statistics (running max m, normalizer l) carried as (block_q, 128)
-  lane-replicated f32 tiles — the TPU-friendly layout for per-row scalars;
-- QK^T and PV on the MXU with f32 accumulation (`preferred_element_type`);
-- CPU/tests run the same kernel in interpret mode.
-
-Backward: `jax.custom_vjp` recomputing the dense reference
-(ops/attention.py::attention) — exact gradients (test-pinned), O(T²) memory
-in the backward only. A flash backward kernel is the natural next step; the
-public entry point keeps its signature either way.
+- grid over (batch·heads, rows-of-blocks, cols-of-blocks); the LAST grid
+  dimension is sequential on TPU, so accumulators live in VMEM scratch
+  across its steps and only one (block, D) tile of the streamed operand is
+  resident at a time — max sequence length is HBM-bound, not VMEM-bound;
+- forward carries online-softmax stats (running max m, normalizer l) as
+  (block_q, 128) lane-replicated f32 tiles and additionally writes the
+  per-row logsumexp (the flash residual) as a (bh, T, 1) f32 array;
+- backward is the classic two-kernel split: one kernel grids over q-blocks
+  and streams K/V to accumulate dQ; the other grids over kv-blocks and
+  streams Q/dO to accumulate dK and dV. Both recompute the (bq, bk) score
+  tile from Q·Kᵀ and reconstruct P = exp(S − lse) — no (T, T) tensor ever
+  exists in HBM. The softmax-gradient row term Δ = rowsum(dO ⊙ O) is a
+  cheap elementwise XLA op outside the kernels;
+- every matmul runs on the MXU with f32 accumulation
+  (`preferred_element_type`); CPU/tests run the same kernels in interpret
+  mode;
+- the O(T·D) guarantee holds for token counts the kernels tile cleanly
+  (T ≤ 512 or any multiple of 128 — every ViT in models/vit.py); other T
+  route to the dense op, which materializes the (T, T) scores in both
+  passes (see `_supported`).
 """
 
 from __future__ import annotations
@@ -40,21 +48,33 @@ def _interpret() -> bool:
     return jax.default_backend() != "tpu"
 
 
-def _block(t: int) -> int:
+def _supported(t: int) -> bool:
+    """Shapes the kernels tile well: one whole-T block (small/odd T) or an
+    exact multiple of the 128-lane tile. Anything else (e.g. prime T above
+    512) would degrade to misaligned micro-blocks — the public entry point
+    routes those to the dense op instead."""
+    return t <= 512 or t % 128 == 0
+
+
+def _block(t: int, cap: int = 1024) -> int:
     for b in (1024, 512, 256, 128):
-        if t % b == 0:
+        if b <= cap and t % b == 0:
             return b
+    assert t <= cap, f"unsupported T={t} reached the kernel (see _supported)"
     return t  # small/odd T: single block (VMEM easily holds it)
 
 
-def _flash_kernel(q_ref, k_ref, v_ref, o_ref, m_scr, l_scr, acc_scr, *,
-                  scale, nk):
+# ---------------------------------------------------------------------------
+# forward
+# ---------------------------------------------------------------------------
+
+def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
+                  *, scale, nk):
     """One (batch·head, q-block, kv-block) grid step.
 
     The kv axis is the LAST grid dimension — sequential on TPU — so the
     online-softmax accumulators persist in VMEM scratch across kv steps and
-    only one (block_k, D) K/V tile is resident at a time: max sequence
-    length is HBM-bound, not VMEM-bound."""
+    only one (block_k, D) K/V tile is resident at a time."""
     kk = pl.program_id(2)
     q = q_ref[0].astype(jnp.float32)            # (bq, D)
     bq, d = q.shape
@@ -88,16 +108,21 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, m_scr, l_scr, acc_scr, *,
     @pl.when(kk == nk - 1)
     def _write():
         o_ref[0] = (acc_new / l_new[:, :1]).astype(o_ref.dtype)
+        lse_ref[0] = m_new[:, :1] + jnp.log(l_new[:, :1])
 
 
 def _flash_forward(q3, k3, v3, scale):
+    """(bh, T, D) ×3 → (out (bh, T, D), lse (bh, T, 1) f32)."""
     bh, t, d = q3.shape
     bq = _block(t)
     bk = _block(t)
     grid = (bh, t // bq, t // bk)
     return pl.pallas_call(
         functools.partial(_flash_kernel, scale=scale, nk=t // bk),
-        out_shape=jax.ShapeDtypeStruct((bh, t, d), q3.dtype),
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, t, d), q3.dtype),
+            jax.ShapeDtypeStruct((bh, t, 1), jnp.float32),
+        ],
         grid=grid,
         in_specs=[
             pl.BlockSpec((1, bq, d), lambda i, j, kk: (i, j, 0),
@@ -107,8 +132,12 @@ def _flash_forward(q3, k3, v3, scale):
             pl.BlockSpec((1, bk, d), lambda i, j, kk: (i, kk, 0),
                          memory_space=pltpu.VMEM),
         ],
-        out_specs=pl.BlockSpec((1, bq, d), lambda i, j, kk: (i, j, 0),
-                               memory_space=pltpu.VMEM),
+        out_specs=[
+            pl.BlockSpec((1, bq, d), lambda i, j, kk: (i, j, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, bq, 1), lambda i, j, kk: (i, j, 0),
+                         memory_space=pltpu.VMEM),
+        ],
         scratch_shapes=[
             pltpu.VMEM((bq, _LANES), jnp.float32),   # running max m
             pltpu.VMEM((bq, _LANES), jnp.float32),   # normalizer l
@@ -118,33 +147,214 @@ def _flash_forward(q3, k3, v3, scale):
     )(q3, k3, v3)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3,))
+# ---------------------------------------------------------------------------
+# backward
+# ---------------------------------------------------------------------------
+
+def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dsum_ref, dq_ref,
+               dq_scr, *, scale, nk):
+    """Grid (bh, q-block, kv-block): stream K/V past a fixed q block,
+    accumulating dQ = Σ_k dS·K·scale in VMEM scratch."""
+    kk = pl.program_id(2)
+    q = q_ref[0].astype(jnp.float32)            # (bq, D)
+    bq, d = q.shape
+
+    @pl.when(kk == 0)
+    def _init():
+        dq_scr[:] = jnp.zeros((bq, d), jnp.float32)
+
+    kb = k_ref[0].astype(jnp.float32)           # (bk, D)
+    vb = v_ref[0].astype(jnp.float32)
+    do = do_ref[0].astype(jnp.float32)          # (bq, D)
+    lse = lse_ref[0]                            # (bq, 1) f32
+    dsum = dsum_ref[0]                          # (bq, 1) f32
+    s = jax.lax.dot_general(
+        q, kb, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32) * scale              # (bq, bk)
+    p = jnp.exp(s - lse)
+    dp = jax.lax.dot_general(
+        do, vb, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32)                      # (bq, bk)
+    ds = p * (dp - dsum)
+    dq_scr[:] += jax.lax.dot_general(
+        ds, kb, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32) * scale
+
+    @pl.when(kk == nk - 1)
+    def _write():
+        dq_ref[0] = dq_scr[:].astype(dq_ref.dtype)
+
+
+def _dkv_kernel(k_ref, v_ref, q_ref, do_ref, lse_ref, dsum_ref,
+                dk_ref, dv_ref, dk_scr, dv_scr, *, scale, nq):
+    """Grid (bh, kv-block, q-block): stream Q/dO past a fixed kv block,
+    accumulating dK = Σ_q dSᵀ·Q·scale and dV = Σ_q Pᵀ·dO in VMEM scratch."""
+    qq = pl.program_id(2)
+    kb = k_ref[0].astype(jnp.float32)           # (bk, D)
+    vb = v_ref[0].astype(jnp.float32)
+    bk, d = kb.shape
+
+    @pl.when(qq == 0)
+    def _init():
+        dk_scr[:] = jnp.zeros((bk, d), jnp.float32)
+        dv_scr[:] = jnp.zeros((bk, d), jnp.float32)
+
+    q = q_ref[0].astype(jnp.float32)            # (bq, D)
+    do = do_ref[0].astype(jnp.float32)          # (bq, D)
+    lse = lse_ref[0]                            # (bq, 1) f32
+    dsum = dsum_ref[0]                          # (bq, 1) f32
+    s = jax.lax.dot_general(
+        q, kb, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32) * scale              # (bq, bk)
+    p = jnp.exp(s - lse)
+    dv_scr[:] += jax.lax.dot_general(
+        p, do, (((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)                      # (bk, D)
+    dp = jax.lax.dot_general(
+        do, vb, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32)                      # (bq, bk)
+    ds = p * (dp - dsum)
+    dk_scr[:] += jax.lax.dot_general(
+        ds, q, (((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32) * scale
+
+    @pl.when(qq == nq - 1)
+    def _write():
+        dk_ref[0] = dk_scr[:].astype(dk_ref.dtype)
+        dv_ref[0] = dv_scr[:].astype(dv_ref.dtype)
+
+
+def _flash_backward_impl(q3, k3, v3, do3, lse, dsum, scale):
+    """(bh, T, D) q/k/v/dO + (bh, T, 1) lse/Δ → (dq, dk, dv), O(T·D) HBM.
+
+    The score tile is recomputed per block pair in both kernels; the only
+    HBM residuals are out/lse from the forward. Blocks are capped at 512 so
+    the (bq, bk) f32 score/probability tiles plus the (block, D) operand
+    tiles fit VMEM alongside the accumulators."""
+    bh, t, d = q3.shape
+    bq = _block(t, cap=512)
+    bk = _block(t, cap=512)
+    nq, nk = t // bq, t // bk
+
+    dq = pl.pallas_call(
+        functools.partial(_dq_kernel, scale=scale, nk=nk),
+        out_shape=jax.ShapeDtypeStruct((bh, t, d), q3.dtype),
+        grid=(bh, nq, nk),
+        in_specs=[
+            pl.BlockSpec((1, bq, d), lambda i, j, kk: (i, j, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, bk, d), lambda i, j, kk: (i, kk, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, bk, d), lambda i, j, kk: (i, kk, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, bq, d), lambda i, j, kk: (i, j, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, bq, 1), lambda i, j, kk: (i, j, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, bq, 1), lambda i, j, kk: (i, j, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((1, bq, d), lambda i, j, kk: (i, j, 0),
+                               memory_space=pltpu.VMEM),
+        scratch_shapes=[pltpu.VMEM((bq, d), jnp.float32)],
+        interpret=_interpret(),
+    )(q3, k3, v3, do3, lse, dsum)
+
+    dk, dv = pl.pallas_call(
+        functools.partial(_dkv_kernel, scale=scale, nq=nq),
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, t, d), k3.dtype),
+            jax.ShapeDtypeStruct((bh, t, d), v3.dtype),
+        ],
+        grid=(bh, nk, nq),
+        in_specs=[
+            pl.BlockSpec((1, bk, d), lambda i, j, qq: (i, j, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, bk, d), lambda i, j, qq: (i, j, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, bq, d), lambda i, j, qq: (i, qq, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, bq, d), lambda i, j, qq: (i, qq, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, bq, 1), lambda i, j, qq: (i, qq, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, bq, 1), lambda i, j, qq: (i, qq, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, bk, d), lambda i, j, qq: (i, j, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, bk, d), lambda i, j, qq: (i, j, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((bk, d), jnp.float32),
+            pltpu.VMEM((bk, d), jnp.float32),
+        ],
+        interpret=_interpret(),
+    )(k3, v3, q3, do3, lse, dsum)
+    return dq, dk, dv
+
+
+# ---------------------------------------------------------------------------
+# public entry point
+# ---------------------------------------------------------------------------
+
+def _to3(x):
+    b, t, h, d = x.shape
+    return x.transpose(0, 2, 1, 3).reshape(b * h, t, d)
+
+
+def _to4(x3, b, h):
+    bh, t, d = x3.shape
+    return x3.reshape(b, h, t, d).transpose(0, 2, 1, 3)
+
+
 def flash_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
                     scale: Optional[float] = None) -> jnp.ndarray:
     """Bidirectional attention, (B, T, H, D) → (B, T, H, D).
 
-    Forward is the Pallas streaming kernel; gradients recompute the dense
-    reference (exact — see module docstring).
+    Forward and backward are both Pallas streaming kernels: O(T·D) HBM
+    traffic, no (T, T) tensor materialized in either pass. Token counts
+    the kernels cannot tile cleanly (see `_supported`) fall back to the
+    framework's dense op — same math, same signature.
     """
-    if scale is None:
-        scale = q.shape[-1] ** -0.5
-    b, t, h, d = q.shape
-    to3 = lambda x: x.transpose(0, 2, 1, 3).reshape(b * h, t, d)  # noqa: E731
-    out = _flash_forward(to3(q), to3(k), to3(v), scale)
-    return out.reshape(b, h, t, d).transpose(0, 2, 1, 3)
+    if not _supported(q.shape[1]):
+        from .attention import attention
+
+        return attention(q, k, v, scale=scale)
+    return _flash(q, k, v, scale)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3,))
+def _flash(q, k, v, scale):
+    return _fa_fwd(q, k, v, scale)[0]
 
 
 def _fa_fwd(q, k, v, scale):
-    return flash_attention(q, k, v, scale), (q, k, v)
+    s = scale if scale is not None else q.shape[-1] ** -0.5
+    b, _, h, _ = q.shape
+    q3, k3, v3 = _to3(q), _to3(k), _to3(v)
+    out3, lse = _flash_forward(q3, k3, v3, s)
+    # Residuals keep the 3D views the backward kernels consume directly —
+    # saving the 4D originals instead would re-pay three transpose passes.
+    return _to4(out3, b, h), (q3, k3, v3, out3, lse)
 
 
 def _fa_bwd(scale, res, g):
-    from .attention import attention  # the framework's dense reference op
+    q3, k3, v3, out3, lse = res
+    # Re-resolve from the static nondiff arg: the kernels bake `scale` into
+    # their compiled body, so it must stay a Python float, not a residual
+    # array.
+    s = scale if scale is not None else q3.shape[-1] ** -0.5
+    b, _, h, _ = g.shape  # cotangent carries the static 4D layout
+    do3 = _to3(g)
+    # Softmax-gradient row term Δ = rowsum(dO ⊙ O): one elementwise pass,
+    # f32, shaped like lse so the kernels read it as a (bq, 1) tile.
+    dsum = jnp.sum(do3.astype(jnp.float32) * out3.astype(jnp.float32),
+                   axis=-1, keepdims=True)
+    dq3, dk3, dv3 = _flash_backward_impl(q3, k3, v3, do3, lse, dsum, s)
+    return (_to4(dq3, b, h), _to4(dk3, b, h), _to4(dv3, b, h))
 
-    q, k, v = res
-    s = scale if scale is not None else q.shape[-1] ** -0.5
-    _, vjp = jax.vjp(lambda q, k, v: attention(q, k, v, scale=s), q, k, v)
-    return vjp(g)
 
-
-flash_attention.defvjp(_fa_fwd, _fa_bwd)
+_flash.defvjp(_fa_fwd, _fa_bwd)

@@ -17,12 +17,47 @@ def _qkv(b=2, t=128, h=2, d=32, seed=0, dtype=jnp.float32):
 
 @pytest.mark.parametrize("t", [128, 196, 256])
 def test_flash_matches_dense(t):
-    """Covers the multi-block path (256 → two 128-blocks) and the odd-T
-    single-block fallback (196)."""
+    """Aligned (128/256) and odd-T single-block fallback (196) forwards.
+    Multi-block streaming is pinned below with a shrunken block size."""
     q, k, v = _qkv(t=t)
     np.testing.assert_allclose(
         np.asarray(flash_attention(q, k, v)),
         np.asarray(attention(q, k, v)), atol=1e-5)
+
+
+def test_flash_multiblock_forward_and_backward(monkeypatch):
+    """Shrink the block size to 64 so T=256 genuinely streams 4 blocks:
+    exercises the forward's online-softmax rescaling across kv steps and
+    BOTH backward kernels' scratch init/accumulate/write paths
+    (kk==0 / += / kk==nk-1), which full-size blocks only hit at T ≥ 1024."""
+    import importlib
+
+    # the ops package re-exports a same-named function, so plain imports
+    # resolve to it instead of the module
+    fa = importlib.import_module(
+        "ddp_classification_pytorch_tpu.ops.flash_attention")
+    monkeypatch.setattr(fa, "_block", lambda t, cap=1024: 64)
+    q, k, v = _qkv(t=256)
+    np.testing.assert_allclose(
+        np.asarray(fa.flash_attention(q, k, v)),
+        np.asarray(attention(q, k, v)), atol=1e-5)
+    gf = jax.grad(lambda q, k, v: (fa.flash_attention(q, k, v) ** 2).mean(),
+                  argnums=(0, 1, 2))(q, k, v)
+    gd = jax.grad(lambda q, k, v: (attention(q, k, v) ** 2).mean(),
+                  argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(gf, gd):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-5)
+
+
+def test_flash_unsupported_t_falls_back_to_dense():
+    """Prime T above 512 cannot tile cleanly; the public entry point must
+    route to the dense op (same values, gradients still defined)."""
+    q, k, v = _qkv(b=1, t=521, h=1, d=16)
+    np.testing.assert_allclose(
+        np.asarray(flash_attention(q, k, v)),
+        np.asarray(attention(q, k, v)), atol=1e-5)
+    g = jax.grad(lambda q: (flash_attention(q, k, v) ** 2).mean())(q)
+    assert np.all(np.isfinite(np.asarray(g)))
 
 
 def test_flash_bf16_close_to_f32_dense():
@@ -35,8 +70,13 @@ def test_flash_bf16_close_to_f32_dense():
         np.asarray(out, np.float32), np.asarray(ref), atol=3e-2)
 
 
-def test_flash_gradients_match_dense():
-    q, k, v = _qkv(t=128)
+@pytest.mark.parametrize("t", [128, 196, 256])
+def test_flash_gradients_match_dense(t):
+    """Single-block backward over aligned (128/256) and odd-T (196) shapes.
+    The multi-block accumulation paths are pinned separately below with a
+    shrunken block size (full-scale blocks only split at T ≥ 1024, too slow
+    for interpret mode)."""
+    q, k, v = _qkv(t=t)
 
     def loss_flash(q, k, v):
         return (flash_attention(q, k, v) ** 2).mean()
