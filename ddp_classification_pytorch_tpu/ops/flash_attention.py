@@ -68,16 +68,28 @@ def _block(t: int, cap: int = 1024) -> int:
 # forward
 # ---------------------------------------------------------------------------
 
+def _causal_mask(bq: int, bk: int, jq, jk):
+    """(bq, bk) bool, True where query row ≥ key col in GLOBAL indices for
+    q-block jq / kv-block jk (block-local iota + block offsets)."""
+    rows = jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0) + jq * bq
+    cols = jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1) + jk * bk
+    return rows >= cols
+
+
 def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
-                  *, scale, nk):
+                  *, scale, nk, causal):
     """One (batch·head, q-block, kv-block) grid step.
 
     The kv axis is the LAST grid dimension — sequential on TPU — so the
     online-softmax accumulators persist in VMEM scratch across kv steps and
     only one (block_k, D) K/V tile is resident at a time."""
-    kk = pl.program_id(2)
-    q = q_ref[0].astype(jnp.float32)            # (bq, D)
+    jq, kk = pl.program_id(1), pl.program_id(2)
+    # Operands stay in their input dtype (bf16 in the default recipe) so the
+    # MXU runs at full rate; every accumulation is f32 via
+    # preferred_element_type, and the softmax statistics are f32 throughout.
+    q = q_ref[0]                                # (bq, D)
     bq, d = q.shape
+    bk = k_ref.shape[1]
 
     @pl.when(kk == 0)
     def _init():
@@ -85,40 +97,65 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
         l_scr[:] = jnp.zeros((bq, _LANES), jnp.float32)
         acc_scr[:] = jnp.zeros((bq, d), jnp.float32)
 
-    kb = k_ref[0].astype(jnp.float32)           # (bk, D)
-    vb = v_ref[0].astype(jnp.float32)
-    s = jax.lax.dot_general(
-        q, kb, (((1,), (1,)), ((), ())),
-        preferred_element_type=jnp.float32) * scale              # (bq, bk)
-    m = m_scr[:]
-    m_cur = jnp.max(s, axis=-1, keepdims=True)                   # (bq, 1)
-    m_new = jnp.maximum(m, jnp.broadcast_to(m_cur, (bq, _LANES)))
-    corr = jnp.exp(m - m_new)                                    # (bq, LANES)
-    p = jnp.exp(s - m_new[:, :1])                                # (bq, bk)
-    l_new = l_scr[:] * corr + jnp.broadcast_to(
-        jnp.sum(p, axis=-1, keepdims=True), (bq, _LANES))
-    pv = jax.lax.dot_general(
-        p, vb, (((1,), (0,)), ((), ())),
-        preferred_element_type=jnp.float32)                      # (bq, D)
-    acc_new = acc_scr[:] * corr[:, :1] + pv
-    m_scr[:] = m_new
-    l_scr[:] = l_new
-    acc_scr[:] = acc_new
+    def _update():
+        kb = k_ref[0]                           # (bk, D)
+        vb = v_ref[0]
+        s = jax.lax.dot_general(
+            q, kb, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale          # (bq, bk)
+        if causal:
+            allowed = _causal_mask(bq, bk, jq, kk)
+            s = jnp.where(allowed, s, _NEG_INF)
+        m = m_scr[:]
+        m_cur = jnp.max(s, axis=-1, keepdims=True)               # (bq, 1)
+        m_new = jnp.maximum(m, jnp.broadcast_to(m_cur, (bq, _LANES)))
+        corr = jnp.exp(m - m_new)                                # (bq, LANES)
+        # Masked entries need no re-zeroing: kv-block 0 (never skipped)
+        # contains column 0, causally allowed for every row, so m_new is
+        # finite after the first step and exp(−NEG_INF − m) underflows to
+        # exactly 0.
+        p = jnp.exp(s - m_new[:, :1])                            # (bq, bk)
+        l_new = l_scr[:] * corr + jnp.broadcast_to(
+            jnp.sum(p, axis=-1, keepdims=True), (bq, _LANES))
+        pv = jax.lax.dot_general(
+            p.astype(vb.dtype), vb, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)                  # (bq, D)
+        acc_scr[:] = acc_scr[:] * corr[:, :1] + pv
+        m_scr[:] = m_new
+        l_scr[:] = l_new
+
+    if causal:
+        # Skip tiles entirely above the diagonal — roughly halves causal
+        # FLOPs. The K/V index maps clamp to the diagonal block for these
+        # steps, so the already-resident tile is re-referenced and the DMA
+        # is elided too (halved HBM traffic).
+        pl.when(kk * bk < (jq + 1) * bq)(_update)
+    else:
+        _update()
 
     @pl.when(kk == nk - 1)
     def _write():
-        o_ref[0] = (acc_new / l_new[:, :1]).astype(o_ref.dtype)
-        lse_ref[0] = m_new[:, :1] + jnp.log(l_new[:, :1])
+        o_ref[0] = (acc_scr[:] / l_scr[:, :1]).astype(o_ref.dtype)
+        lse_ref[0] = m_scr[:, :1] + jnp.log(l_scr[:, :1])
 
 
-def _flash_forward(q3, k3, v3, scale):
+def _flash_forward(q3, k3, v3, scale, causal=False):
     """(bh, T, D) ×3 → (out (bh, T, D), lse (bh, T, 1) f32)."""
     bh, t, d = q3.shape
     bq = _block(t)
     bk = _block(t)
     grid = (bh, t // bq, t // bk)
+    if causal:
+        # Above-diagonal steps are compute-skipped in the kernel; clamping
+        # the fetched kv block to the diagonal makes those steps re-request
+        # the resident tile, so their DMA is elided as well (bq == bk by
+        # construction of _block).
+        kv_idx = lambda i, j, kk: (i, jnp.minimum(kk, j), 0)  # noqa: E731
+    else:
+        kv_idx = lambda i, j, kk: (i, kk, 0)  # noqa: E731
     return pl.pallas_call(
-        functools.partial(_flash_kernel, scale=scale, nk=t // bk),
+        functools.partial(_flash_kernel, scale=scale, nk=t // bk,
+                          causal=causal),
         out_shape=[
             jax.ShapeDtypeStruct((bh, t, d), q3.dtype),
             jax.ShapeDtypeStruct((bh, t, 1), jnp.float32),
@@ -127,10 +164,8 @@ def _flash_forward(q3, k3, v3, scale):
         in_specs=[
             pl.BlockSpec((1, bq, d), lambda i, j, kk: (i, j, 0),
                          memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, bk, d), lambda i, j, kk: (i, kk, 0),
-                         memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, bk, d), lambda i, j, kk: (i, kk, 0),
-                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, bk, d), kv_idx, memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, bk, d), kv_idx, memory_space=pltpu.VMEM),
         ],
         out_specs=[
             pl.BlockSpec((1, bq, d), lambda i, j, kk: (i, j, 0),
@@ -152,33 +187,45 @@ def _flash_forward(q3, k3, v3, scale):
 # ---------------------------------------------------------------------------
 
 def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dsum_ref, dq_ref,
-               dq_scr, *, scale, nk):
+               dq_scr, *, scale, nk, causal):
     """Grid (bh, q-block, kv-block): stream K/V past a fixed q block,
     accumulating dQ = Σ_k dS·K·scale in VMEM scratch."""
-    kk = pl.program_id(2)
-    q = q_ref[0].astype(jnp.float32)            # (bq, D)
+    jq, kk = pl.program_id(1), pl.program_id(2)
+    q = q_ref[0]                                # (bq, D) input dtype
     bq, d = q.shape
+    bk = k_ref.shape[1]
 
     @pl.when(kk == 0)
     def _init():
         dq_scr[:] = jnp.zeros((bq, d), jnp.float32)
 
-    kb = k_ref[0].astype(jnp.float32)           # (bk, D)
-    vb = v_ref[0].astype(jnp.float32)
-    do = do_ref[0].astype(jnp.float32)          # (bq, D)
-    lse = lse_ref[0]                            # (bq, 1) f32
-    dsum = dsum_ref[0]                          # (bq, 1) f32
-    s = jax.lax.dot_general(
-        q, kb, (((1,), (1,)), ((), ())),
-        preferred_element_type=jnp.float32) * scale              # (bq, bk)
-    p = jnp.exp(s - lse)
-    dp = jax.lax.dot_general(
-        do, vb, (((1,), (1,)), ((), ())),
-        preferred_element_type=jnp.float32)                      # (bq, bk)
-    ds = p * (dp - dsum)
-    dq_scr[:] += jax.lax.dot_general(
-        ds, kb, (((1,), (0,)), ((), ())),
-        preferred_element_type=jnp.float32) * scale
+    def _update():
+        kb = k_ref[0]                           # (bk, D)
+        vb = v_ref[0]
+        do = do_ref[0]                          # (bq, D)
+        lse = lse_ref[0]                        # (bq, 1) f32
+        dsum = dsum_ref[0]                      # (bq, 1) f32
+        s = jax.lax.dot_general(
+            q, kb, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale          # (bq, bk)
+        if causal:
+            # lse is finite, so exp(−NEG_INF − lse) underflows to exactly
+            # 0 — masking s alone zeroes P (and thus dS) on forbidden
+            # entries.
+            s = jnp.where(_causal_mask(bq, bk, jq, kk), s, _NEG_INF)
+        p = jnp.exp(s - lse)
+        dp = jax.lax.dot_general(
+            do, vb, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)                  # (bq, bk)
+        ds = (p * (dp - dsum)).astype(kb.dtype)
+        dq_scr[:] += jax.lax.dot_general(
+            ds, kb, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale
+
+    if causal:
+        pl.when(kk * bk < (jq + 1) * bq)(_update)  # skip fully-future tiles
+    else:
+        _update()
 
     @pl.when(kk == nk - 1)
     def _write():
@@ -186,37 +233,47 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dsum_ref, dq_ref,
 
 
 def _dkv_kernel(k_ref, v_ref, q_ref, do_ref, lse_ref, dsum_ref,
-                dk_ref, dv_ref, dk_scr, dv_scr, *, scale, nq):
+                dk_ref, dv_ref, dk_scr, dv_scr, *, scale, nq, causal):
     """Grid (bh, kv-block, q-block): stream Q/dO past a fixed kv block,
     accumulating dK = Σ_q dSᵀ·Q·scale and dV = Σ_q Pᵀ·dO in VMEM scratch."""
-    qq = pl.program_id(2)
-    kb = k_ref[0].astype(jnp.float32)           # (bk, D)
-    vb = v_ref[0].astype(jnp.float32)
+    jk, qq = pl.program_id(1), pl.program_id(2)
+    kb = k_ref[0]                               # (bk, D) input dtype
     bk, d = kb.shape
+    bq = q_ref.shape[1]
 
     @pl.when(qq == 0)
     def _init():
         dk_scr[:] = jnp.zeros((bk, d), jnp.float32)
         dv_scr[:] = jnp.zeros((bk, d), jnp.float32)
 
-    q = q_ref[0].astype(jnp.float32)            # (bq, D)
-    do = do_ref[0].astype(jnp.float32)          # (bq, D)
-    lse = lse_ref[0]                            # (bq, 1) f32
-    dsum = dsum_ref[0]                          # (bq, 1) f32
-    s = jax.lax.dot_general(
-        q, kb, (((1,), (1,)), ((), ())),
-        preferred_element_type=jnp.float32) * scale              # (bq, bk)
-    p = jnp.exp(s - lse)
-    dv_scr[:] += jax.lax.dot_general(
-        p, do, (((0,), (0,)), ((), ())),
-        preferred_element_type=jnp.float32)                      # (bk, D)
-    dp = jax.lax.dot_general(
-        do, vb, (((1,), (1,)), ((), ())),
-        preferred_element_type=jnp.float32)                      # (bq, bk)
-    ds = p * (dp - dsum)
-    dk_scr[:] += jax.lax.dot_general(
-        ds, q, (((0,), (0,)), ((), ())),
-        preferred_element_type=jnp.float32) * scale
+    def _update():
+        vb = v_ref[0]
+        q = q_ref[0]                            # (bq, D)
+        do = do_ref[0]                          # (bq, D)
+        lse = lse_ref[0]                        # (bq, 1) f32
+        dsum = dsum_ref[0]                      # (bq, 1) f32
+        s = jax.lax.dot_general(
+            q, kb, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale          # (bq, bk)
+        if causal:
+            # q-block index is the LAST grid dim here; kv-block is dim 1
+            s = jnp.where(_causal_mask(bq, bk, qq, jk), s, _NEG_INF)
+        p = jnp.exp(s - lse)
+        dv_scr[:] += jax.lax.dot_general(
+            p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)                  # (bk, D)
+        dp = jax.lax.dot_general(
+            do, vb, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)                  # (bq, bk)
+        ds = (p * (dp - dsum)).astype(q.dtype)
+        dk_scr[:] += jax.lax.dot_general(
+            ds, q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale
+
+    if causal:
+        pl.when(jk * bk < (qq + 1) * bq)(_update)  # skip fully-future tiles
+    else:
+        _update()
 
     @pl.when(qq == nq - 1)
     def _write():
@@ -224,7 +281,7 @@ def _dkv_kernel(k_ref, v_ref, q_ref, do_ref, lse_ref, dsum_ref,
         dv_ref[0] = dv_scr[:].astype(dv_ref.dtype)
 
 
-def _flash_backward_impl(q3, k3, v3, do3, lse, dsum, scale):
+def _flash_backward_impl(q3, k3, v3, do3, lse, dsum, scale, causal=False):
     """(bh, T, D) q/k/v/dO + (bh, T, 1) lse/Δ → (dq, dk, dv), O(T·D) HBM.
 
     The score tile is recomputed per block pair in both kernels; the only
@@ -236,17 +293,24 @@ def _flash_backward_impl(q3, k3, v3, do3, lse, dsum, scale):
     bk = _block(t, cap=512)
     nq, nk = t // bq, t // bk
 
+    if causal:
+        # Same DMA-elision trick as the forward: compute-skipped steps
+        # re-request the diagonal block (bq == bk by construction).
+        kv_idx = lambda i, j, kk: (i, jnp.minimum(kk, j), 0)  # noqa: E731
+        q_row_idx = lambda i, j, qq: (i, jnp.maximum(qq, j), 0)  # noqa: E731
+    else:
+        kv_idx = lambda i, j, kk: (i, kk, 0)  # noqa: E731
+        q_row_idx = lambda i, j, qq: (i, qq, 0)  # noqa: E731
+
     dq = pl.pallas_call(
-        functools.partial(_dq_kernel, scale=scale, nk=nk),
+        functools.partial(_dq_kernel, scale=scale, nk=nk, causal=causal),
         out_shape=jax.ShapeDtypeStruct((bh, t, d), q3.dtype),
         grid=(bh, nq, nk),
         in_specs=[
             pl.BlockSpec((1, bq, d), lambda i, j, kk: (i, j, 0),
                          memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, bk, d), lambda i, j, kk: (i, kk, 0),
-                         memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, bk, d), lambda i, j, kk: (i, kk, 0),
-                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, bk, d), kv_idx, memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, bk, d), kv_idx, memory_space=pltpu.VMEM),
             pl.BlockSpec((1, bq, d), lambda i, j, kk: (i, j, 0),
                          memory_space=pltpu.VMEM),
             pl.BlockSpec((1, bq, 1), lambda i, j, kk: (i, j, 0),
@@ -261,7 +325,7 @@ def _flash_backward_impl(q3, k3, v3, do3, lse, dsum, scale):
     )(q3, k3, v3, do3, lse, dsum)
 
     dk, dv = pl.pallas_call(
-        functools.partial(_dkv_kernel, scale=scale, nq=nq),
+        functools.partial(_dkv_kernel, scale=scale, nq=nq, causal=causal),
         out_shape=[
             jax.ShapeDtypeStruct((bh, t, d), k3.dtype),
             jax.ShapeDtypeStruct((bh, t, d), v3.dtype),
@@ -272,14 +336,10 @@ def _flash_backward_impl(q3, k3, v3, do3, lse, dsum, scale):
                          memory_space=pltpu.VMEM),
             pl.BlockSpec((1, bk, d), lambda i, j, qq: (i, j, 0),
                          memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, bq, d), lambda i, j, qq: (i, qq, 0),
-                         memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, bq, d), lambda i, j, qq: (i, qq, 0),
-                         memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, bq, 1), lambda i, j, qq: (i, qq, 0),
-                         memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, bq, 1), lambda i, j, qq: (i, qq, 0),
-                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, bq, d), q_row_idx, memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, bq, d), q_row_idx, memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, bq, 1), q_row_idx, memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, bq, 1), q_row_idx, memory_space=pltpu.VMEM),
         ],
         out_specs=[
             pl.BlockSpec((1, bk, d), lambda i, j, qq: (i, j, 0),
@@ -311,37 +371,45 @@ def _to4(x3, b, h):
 
 
 def flash_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
-                    scale: Optional[float] = None) -> jnp.ndarray:
-    """Bidirectional attention, (B, T, H, D) → (B, T, H, D).
+                    scale: Optional[float] = None,
+                    causal: bool = False) -> jnp.ndarray:
+    """Scaled-dot-product attention, (B, T, H, D) → (B, T, H, D), optionally
+    causal (row i attends keys ≤ i, matching ops/attention.py::attention).
 
     Forward and backward are both Pallas streaming kernels: O(T·D) HBM
     traffic, no (T, T) tensor materialized in either pass. Token counts
     the kernels cannot tile cleanly (see `_supported`) fall back to the
     framework's dense op — same math, same signature.
     """
+    if q.shape != k.shape or q.shape != v.shape:
+        # Self-attention kernel: one T for q and kv. Without this check a
+        # shorter k/v would silently read clamped (repeated) tail blocks.
+        raise ValueError(
+            f"flash_attention requires q/k/v of equal shape, got "
+            f"{q.shape}/{k.shape}/{v.shape}")
     if not _supported(q.shape[1]):
         from .attention import attention
 
-        return attention(q, k, v, scale=scale)
-    return _flash(q, k, v, scale)
+        return attention(q, k, v, causal=causal, scale=scale)
+    return _flash(q, k, v, scale, causal)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3,))
-def _flash(q, k, v, scale):
-    return _fa_fwd(q, k, v, scale)[0]
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
+def _flash(q, k, v, scale, causal):
+    return _fa_fwd(q, k, v, scale, causal)[0]
 
 
-def _fa_fwd(q, k, v, scale):
+def _fa_fwd(q, k, v, scale, causal):
     s = scale if scale is not None else q.shape[-1] ** -0.5
     b, _, h, _ = q.shape
     q3, k3, v3 = _to3(q), _to3(k), _to3(v)
-    out3, lse = _flash_forward(q3, k3, v3, s)
+    out3, lse = _flash_forward(q3, k3, v3, s, causal)
     # Residuals keep the 3D views the backward kernels consume directly —
     # saving the 4D originals instead would re-pay three transpose passes.
     return _to4(out3, b, h), (q3, k3, v3, out3, lse)
 
 
-def _fa_bwd(scale, res, g):
+def _fa_bwd(scale, causal, res, g):
     q3, k3, v3, out3, lse = res
     # Re-resolve from the static nondiff arg: the kernels bake `scale` into
     # their compiled body, so it must stay a Python float, not a residual
@@ -353,7 +421,8 @@ def _fa_bwd(scale, res, g):
     # f32, shaped like lse so the kernels read it as a (bq, 1) tile.
     dsum = jnp.sum(do3.astype(jnp.float32) * out3.astype(jnp.float32),
                    axis=-1, keepdims=True)
-    dq3, dk3, dv3 = _flash_backward_impl(q3, k3, v3, do3, lse, dsum, s)
+    dq3, dk3, dv3 = _flash_backward_impl(q3, k3, v3, do3, lse, dsum, s,
+                                         causal)
     return (_to4(dq3, b, h), _to4(dk3, b, h), _to4(dv3, b, h))
 
 

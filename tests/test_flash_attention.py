@@ -49,6 +49,36 @@ def test_flash_multiblock_forward_and_backward(monkeypatch):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-5)
 
 
+@pytest.mark.parametrize("t", [128, 196])
+def test_flash_causal_matches_dense(t):
+    q, k, v = _qkv(t=t)
+    np.testing.assert_allclose(
+        np.asarray(flash_attention(q, k, v, causal=True)),
+        np.asarray(attention(q, k, v, causal=True)), atol=1e-5)
+
+
+def test_flash_causal_gradients_multiblock(monkeypatch):
+    """Block 64 at T=256 → blocks fully below, straddling, and fully above
+    the diagonal all occur, in the forward and BOTH backward kernels."""
+    import importlib
+
+    fa = importlib.import_module(
+        "ddp_classification_pytorch_tpu.ops.flash_attention")
+    monkeypatch.setattr(fa, "_block", lambda t, cap=1024: 64)
+    q, k, v = _qkv(t=256)
+    np.testing.assert_allclose(
+        np.asarray(fa.flash_attention(q, k, v, causal=True)),
+        np.asarray(attention(q, k, v, causal=True)), atol=1e-5)
+    gf = jax.grad(
+        lambda q, k, v: (fa.flash_attention(q, k, v, causal=True) ** 2).mean(),
+        argnums=(0, 1, 2))(q, k, v)
+    gd = jax.grad(
+        lambda q, k, v: (attention(q, k, v, causal=True) ** 2).mean(),
+        argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(gf, gd):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-5)
+
+
 def test_flash_unsupported_t_falls_back_to_dense():
     """Prime T above 512 cannot tile cleanly; the public entry point must
     route to the dense op (same values, gradients still defined)."""
@@ -88,6 +118,22 @@ def test_flash_gradients_match_dense(t):
     gd = jax.grad(loss_dense, argnums=(0, 1, 2))(q, k, v)
     for a, b in zip(gf, gd):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-5)
+
+
+def test_flash_bf16_gradients_close_to_f32_dense():
+    """The backward kernels keep MXU operands in the input dtype (bf16 in
+    the ViT recipe) with f32 accumulation — pin that path against the f32
+    dense gradients with a bf16-appropriate tolerance."""
+    q, k, v = _qkv(dtype=jnp.bfloat16)
+    gf = jax.grad(lambda q, k, v: (flash_attention(q, k, v) ** 2)
+                  .astype(jnp.float32).mean(), argnums=(0, 1, 2))(q, k, v)
+    q32, k32, v32 = (x.astype(jnp.float32) for x in (q, k, v))
+    gd = jax.grad(lambda q, k, v: (attention(q, k, v) ** 2).mean(),
+                  argnums=(0, 1, 2))(q32, k32, v32)
+    for a, b in zip(gf, gd):
+        assert a.dtype == jnp.bfloat16
+        np.testing.assert_allclose(
+            np.asarray(a, np.float32), np.asarray(b), atol=5e-2)
 
 
 def test_flash_under_jit_and_vmap_free_shapes():
