@@ -56,15 +56,12 @@ class MHA(nn.Module):
         qkv = nn.Dense(3 * self.dim, dtype=self.dtype, name="qkv")(x)
         qkv = qkv.reshape(b, t, 3, self.heads, d)
         q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
-        ring = (self.mesh is not None and self.seq_axis
-                and self.mesh.shape[self.seq_axis] > 1)
-        if self.use_flash and not ring:
-            from ..ops.flash_attention import flash_attention
-
-            out = flash_attention(q, k, v)
-        else:
-            out = ring_attention(q, k, v, mesh=self.mesh,
-                                 axis_name=self.seq_axis)
+        # ring_attention owns the whole dispatch: sharded token axis → ring
+        # (with the flash kernel consuming each visiting KV shard when
+        # use_flash), unsharded → direct flash or dense.
+        out = ring_attention(q, k, v, mesh=self.mesh,
+                             axis_name=self.seq_axis,
+                             use_flash=self.use_flash)
         out = out.reshape(b, t, self.dim)
         return nn.Dense(self.dim, dtype=self.dtype, name="proj")(out)
 

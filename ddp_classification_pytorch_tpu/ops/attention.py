@@ -128,6 +128,67 @@ def _ring_shard(q, k, v, *, axis_name: str, axis_size: int, causal: bool,
     return (o / l.transpose(0, 2, 1)[..., None]).astype(q.dtype)
 
 
+def flash_supported(t_local: int) -> bool:
+    """Whether the Pallas flash kernel tiles a (local) sequence length
+    cleanly (lazy import: flash_attention imports this module for its dense
+    fallback)."""
+    from .flash_attention import _supported
+
+    return _supported(t_local)
+
+
+def _ring_shard_flash(q, k, v, *, axis_name: str, axis_size: int,
+                      causal: bool, scale: float):
+    """Flash-kernel ring body: each visiting KV shard is consumed by the
+    Pallas streaming kernel (ops/flash_attention.py), whose (out, lse) pair
+    is exactly the statistic needed to merge visits — so the per-device
+    score tile never materializes even locally. Step 0 is the resident
+    (diagonal) shard, statically known, so the causal case runs the causal
+    kernel there and a two-way past/future `lax.cond` on later visits
+    (per-device runtime branch; no collectives inside, so SPMD-safe)."""
+    from .flash_attention import flash_attention_with_lse
+
+    b, t_local, h, d = q.shape
+    rank = jax.lax.axis_index(axis_name)
+    perm = [(i, (i + 1) % axis_size) for i in range(axis_size)]
+
+    o0, lse0 = flash_attention_with_lse(q, k, v, scale=scale, causal=causal)
+    m = lse0                                       # (B, H, Tl) f32, finite
+    l = jnp.ones_like(lse0)                        # each visit is normalized
+    o = o0.astype(jnp.float32)
+
+    def visit_full(q, kb, vb):
+        out, lse = flash_attention_with_lse(q, kb, vb, scale=scale)
+        return out.astype(jnp.float32), lse
+
+    def visit_future(q, kb, vb):
+        # entirely in the query's future: contributes nothing; _NEG_INF (not
+        # -inf) keeps exp(lse − m) = 0 without inf−inf NaNs in the merge
+        return (jnp.zeros((b, t_local, h, d), jnp.float32),
+                jnp.full((b, h, t_local), _NEG_INF, jnp.float32))
+
+    def body(step, carry):
+        kb, vb, m, l, o = carry
+        kb = jax.lax.ppermute(kb, axis_name, perm)
+        vb = jax.lax.ppermute(vb, axis_name, perm)
+        src = (rank - step) % axis_size            # origin of this KV shard
+        if causal:
+            o_i, lse_i = jax.lax.cond(src < rank, visit_full, visit_future,
+                                      q, kb, vb)
+        else:
+            o_i, lse_i = visit_full(q, kb, vb)
+        m_new = jnp.maximum(m, lse_i)
+        c_run = jnp.exp(m - m_new)                 # (B, H, Tl)
+        c_vis = jnp.exp(lse_i - m_new)
+        l = l * c_run + c_vis
+        o = (o * c_run.transpose(0, 2, 1)[..., None]
+             + o_i * c_vis.transpose(0, 2, 1)[..., None])
+        return kb, vb, m_new, l, o
+
+    _, _, m, l, o = jax.lax.fori_loop(1, axis_size, body, (k, v, m, l, o))
+    return (o / l.transpose(0, 2, 1)[..., None]).astype(q.dtype)
+
+
 def ring_attention(
     q: jnp.ndarray,
     k: jnp.ndarray,
@@ -136,26 +197,36 @@ def ring_attention(
     axis_name: Optional[str] = None,
     causal: bool = False,
     scale: Optional[float] = None,
+    use_flash: bool = False,
 ) -> jnp.ndarray:
     """Exact attention with the sequence axis sharded over `axis_name`.
 
     q, k, v: (B, T, H, D) with T divisible by the axis size. Falls back to
     the dense op when no mesh/axis is given or the axis has size 1 — model
     code calls this unconditionally and the single-chip path stays a single
-    fused XLA computation.
+    fused XLA computation. `use_flash` consumes each visiting KV shard with
+    the Pallas streaming kernel instead of the blockwise einsum (requires a
+    kernel-tileable local length; falls back otherwise).
     """
     if scale is None:
         scale = q.shape[-1] ** -0.5
     n = mesh.shape[axis_name] if (mesh is not None and axis_name) else 1
     if n <= 1:
+        if use_flash:
+            from .flash_attention import flash_attention
+
+            # flash_attention routes kernel-untileable T to the dense op
+            return flash_attention(q, k, v, scale=scale, causal=causal)
         return attention(q, k, v, causal=causal, scale=scale)
     t = q.shape[1]
     if t % n:
         raise ValueError(
             f"sequence length {t} not divisible by ring size {n} "
             f"(mesh axis {axis_name!r})")
+    shard_body = (_ring_shard_flash
+                  if use_flash and flash_supported(t // n) else _ring_shard)
     body = functools.partial(
-        _ring_shard, axis_name=axis_name, axis_size=n, causal=causal,
+        shard_body, axis_name=axis_name, axis_size=n, causal=causal,
         scale=scale)
     # Batch dim shards over every OTHER >1 mesh axis (the 'data' axis in this
     # framework's meshes): the ring body is batch-local, and leaving the batch

@@ -427,3 +427,61 @@ def _fa_bwd(scale, causal, res, g):
 
 
 _flash.defvjp(_fa_fwd, _fa_bwd)
+
+
+# ---------------------------------------------------------------------------
+# (out, lse) variant — building block for ring/blockwise composition
+# ---------------------------------------------------------------------------
+
+def flash_attention_with_lse(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
+                             scale: Optional[float] = None,
+                             causal: bool = False):
+    """Like `flash_attention` but also returns the per-row logsumexp of the
+    scaled scores as (B, H, T) f32 — exactly the statistic needed to merge
+    partial attention over KV blocks held on other devices (ring attention,
+    ops/attention.py). Both outputs are differentiable: an lse cotangent
+    folds into the kernels' Δ term (dS = P ⊙ (dP − (Δ − ḡ_lse))), so the
+    merged result backpropagates exactly.
+
+    Requires a kernel-supported T (see `_supported`); callers gate on that.
+    """
+    if q.shape != k.shape or q.shape != v.shape:
+        raise ValueError(
+            f"flash_attention_with_lse requires q/k/v of equal shape, got "
+            f"{q.shape}/{k.shape}/{v.shape}")
+    if not _supported(q.shape[1]):
+        raise ValueError(
+            f"T={q.shape[1]} is not kernel-tileable (need T ≤ 512 or a "
+            "multiple of 128)")
+    return _flash_lse(q, k, v, scale, causal)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
+def _flash_lse(q, k, v, scale, causal):
+    return _fl_fwd(q, k, v, scale, causal)[0]
+
+
+def _fl_fwd(q, k, v, scale, causal):
+    out, res = _fa_fwd(q, k, v, scale, causal)
+    b, _, h, _ = q.shape
+    lse = res[4]  # (bh, T, 1) f32
+    return (out, lse[:, :, 0].reshape(b, h, -1)), res
+
+
+def _fl_bwd(scale, causal, res, g):
+    g_out, g_lse = g
+    q3, k3, v3, out3, lse = res
+    s = scale if scale is not None else q3.shape[-1] ** -0.5
+    b, _, h, _ = g_out.shape
+    do3 = _to3(g_out)
+    # lse cotangent: dlse/dS = P, so dS = P ⊙ (dP − Δ) + P·ḡ_lse
+    #              = P ⊙ (dP − (Δ − ḡ_lse)) — fold ḡ_lse into the Δ input.
+    dsum = jnp.sum(do3.astype(jnp.float32) * out3.astype(jnp.float32),
+                   axis=-1, keepdims=True)
+    dsum = dsum - g_lse.astype(jnp.float32).reshape(b * h, -1)[:, :, None]
+    dq3, dk3, dv3 = _flash_backward_impl(q3, k3, v3, do3, lse, dsum, s,
+                                         causal)
+    return (_to4(dq3, b, h), _to4(dk3, b, h), _to4(dv3, b, h))
+
+
+_flash_lse.defvjp(_fl_fwd, _fl_bwd)

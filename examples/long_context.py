@@ -1,11 +1,12 @@
 """Long-context / multi-axis parallelism demo on a virtual 8-device mesh.
 
-Runs three flavors of the SAME ViT training step — pure DP, DP × ring-
-attention sequence parallelism, and DP × GPipe pipeline parallelism. The DP
-and SP rows print IDENTICAL losses (same flax params, and ring attention is
-exact); the PP row uses the pipelined model's own initializer, so its
-trajectory differs while test_pipeline.py pins its math to the sequential
-reference. No TPU needed:
+Runs four flavors of the SAME ViT training step — pure DP, DP × ring-
+attention sequence parallelism (blockwise and flash-kernel variants), and
+DP × GPipe pipeline parallelism. The DP and both SP rows print IDENTICAL
+losses (same flax params, and ring attention is exact in either variant);
+the PP row uses the pipelined model's own initializer, so its trajectory
+differs while test_pipeline.py pins its math to the sequential reference.
+No TPU needed:
 
     python examples/long_context.py
 
@@ -33,7 +34,7 @@ from ddp_classification_pytorch_tpu.train.state import create_train_state
 from ddp_classification_pytorch_tpu.train.steps import make_train_step
 
 
-def run(name, dp, mp, pp_microbatches=0, steps=3):
+def run(name, dp, mp, pp_microbatches=0, steps=3, flash=False):
     cfg = get_preset("baseline")
     cfg.model.arch = "vit_t16"
     cfg.model.dtype = "float32"
@@ -42,6 +43,7 @@ def run(name, dp, mp, pp_microbatches=0, steps=3):
     cfg.data.batch_size = 16
     cfg.parallel.model_axis = mp
     cfg.parallel.pipeline_microbatches = pp_microbatches
+    cfg.model.flash_attention = flash
 
     mesh = meshlib.make_mesh(meshlib.MeshSpec(dp, mp))
     rng = np.random.default_rng(0)
@@ -63,4 +65,5 @@ def run(name, dp, mp, pp_microbatches=0, steps=3):
 if __name__ == "__main__":
     run("DP only", 8, 1)
     run("DP × SP (ring attention)", 4, 2)
+    run("DP × SP (flash ring)", 4, 2, flash=True)
     run("DP × PP (GPipe, M=4)", 4, 2, pp_microbatches=4)
