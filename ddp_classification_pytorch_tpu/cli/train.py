@@ -143,6 +143,10 @@ def build_parser() -> argparse.ArgumentParser:
     par.add_argument("--dcn_slices", type=int, default=0,
                      help="multi-slice pods: two-tier mesh with DP across "
                           "N DCN-connected slices, model axis on ICI")
+    par.add_argument("--sharded_ce", action="store_true",
+                     help="arcface: partial-FC loss — class-sharded "
+                          "softmax-CE over the model axis, no (B, C) "
+                          "logits (needs --mp > 1, classes divisible)")
     par.add_argument("--multihost", action="store_true",
                      help="call jax.distributed.initialize() (TPU pods)")
 
@@ -278,6 +282,8 @@ def config_from_args(args: argparse.Namespace) -> Config:
         cfg.parallel.pipeline_microbatches = args.pp_microbatches
     if args.dcn_slices:
         cfg.parallel.dcn_slices = args.dcn_slices
+    if args.sharded_ce:
+        cfg.parallel.arcface_sharded_ce = True
     return cfg
 
 

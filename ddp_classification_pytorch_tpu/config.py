@@ -136,6 +136,11 @@ class ParallelConfig:
     # two-tier mesh (parallel/mesh.py::make_hybrid_mesh) — DP spans slices
     # (one DCN allreduce/step), model axis stays inside a slice on ICI.
     dcn_slices: int = 0
+    # partial-FC-style ArcFace loss: compute softmax-CE with the class dim
+    # sharded over the model axis (ops/sharded_head.py) — no (B, C) logits
+    # anywhere. The scale path for 10⁵-10⁶-identity heads; requires
+    # model_axis > 1 and num_classes divisible by it.
+    arcface_sharded_ce: bool = False
 
 
 @dataclass

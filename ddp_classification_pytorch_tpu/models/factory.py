@@ -94,6 +94,12 @@ class ArcFaceModel(nn.Module):
         emb = self.embedding(feat)
         return self.margin(emb, labels)
 
+    def features(self, x, train: bool = True):
+        """Embedding only — the class-sharded CE path (ops/sharded_head.py)
+        consumes embeddings + the raw margin weight, skipping the (B, C)
+        logits the margin head would build."""
+        return self.embedding(self.backbone(x, train=train))
+
 
 class NestedModel(nn.Module):
     """NetFeat + NetClassifier with a feature mask slot (NESTED shape,

@@ -31,6 +31,34 @@ def _l2_normalize(x: jnp.ndarray, axis: int, eps: float = 1e-12) -> jnp.ndarray:
     return x / jnp.maximum(n, eps)
 
 
+def margin_splice(
+    cosine: jnp.ndarray,
+    one_hot: jnp.ndarray,
+    s: float = 30.0,
+    m: float = 0.5,
+    easy_margin: bool = False,
+) -> jnp.ndarray:
+    """cos θ (any block of the class dim) + one-hot → scaled margin logits.
+
+    The margin core of arc_main.py:157-176, factored so the dense path and
+    the class-sharded partial-FC path (ops/sharded_head.py) share one
+    implementation — their exactness contract depends on identical math.
+    `one_hot` rows may be all-zero (label owned by another class shard)."""
+    cos_m, sin_m = math.cos(m), math.sin(m)
+    th = math.cos(math.pi - m)
+    mm = math.sin(math.pi - m) * m
+
+    sine = jnp.sqrt(jnp.clip(1.0 - cosine**2, 0.0, 1.0))
+    phi = cosine * cos_m - sine * sin_m
+    if easy_margin:
+        phi = jnp.where(cosine > 0, phi, cosine)
+    else:
+        # past the flip point cos(θ+m) stops being monotonic; fall back to a
+        # linear penalty (standard ArcFace trick, arc_main.py:164-165)
+        phi = jnp.where(cosine > th, phi, cosine - mm)
+    return (one_hot * phi + (1.0 - one_hot) * cosine) * s
+
+
 def arc_margin_logits(
     features: jnp.ndarray,
     weight: jnp.ndarray,
@@ -46,21 +74,9 @@ def arc_margin_logits(
     """
     features = features.astype(jnp.float32)
     weight = weight.astype(jnp.float32)
-    cos_m, sin_m = math.cos(m), math.sin(m)
-    th = math.cos(math.pi - m)
-    mm = math.sin(math.pi - m) * m
-
     cosine = _l2_normalize(features, 1) @ _l2_normalize(weight, 1).T
-    sine = jnp.sqrt(jnp.clip(1.0 - cosine**2, 0.0, 1.0))
-    phi = cosine * cos_m - sine * sin_m
-    if easy_margin:
-        phi = jnp.where(cosine > 0, phi, cosine)
-    else:
-        # past the flip point cos(θ+m) stops being monotonic; fall back to a
-        # linear penalty (standard ArcFace trick, arc_main.py:164-165)
-        phi = jnp.where(cosine > th, phi, cosine - mm)
     one_hot = jnp.zeros_like(cosine).at[jnp.arange(labels.shape[0]), labels].set(1.0)
-    return (one_hot * phi + (1.0 - one_hot) * cosine) * s
+    return margin_splice(cosine, one_hot, s, m, easy_margin)
 
 
 def arcface_naive_log_logits(

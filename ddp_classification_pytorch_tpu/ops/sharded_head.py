@@ -1,0 +1,132 @@
+"""Class-sharded ArcFace cross-entropy — the "partial-FC" scale path.
+
+SURVEY §5 names the class dimension as this workload family's long-context
+analogue: the reference's 2173-identity head (ARCFACE/arc_main.py:234) is
+small, but ArcFace heads scale to 10⁵-10⁶ identities, where the (B, C)
+logit matrix (and its gather) becomes the memory wall. Under plain jit the
+margin weight already shards over the mesh `model` axis
+(parallel/mesh.py::_spec_for_param), but the softmax-CE pulls the full
+(B, C) row per sample together.
+
+This module computes the EXACT mean softmax-CE over arc-margin logits with
+the class dim sharded, shard_map-style, never materializing (B, C) anywhere:
+
+- each device holds a (C/mp, D) weight shard and computes its local
+  (B_local, C/mp) cosine/margin block (margin applied only where the
+  sample's label falls in the local shard);
+- the softmax denominator is an online two-collective reduction: global max
+  via `pmax`, then `psum` of the shifted exponential sums — the class-dim
+  counterpart of ring attention's online softmax;
+- the target logit lives on exactly one shard per sample, so a masked local
+  sum + `psum` recovers it;
+- top-1/top-3 metrics come from per-shard `lax.top_k` candidates merged by
+  a tiny (B_local, k·mp) all-gather — candidates, not logits, cross the
+  ICI.
+
+Everything is differentiable (psum/pmax transpose cleanly), so one
+`jax.grad` over the returned loss trains backbone + margin weight with the
+same math as the dense `ops/arcface.py::arc_margin_logits` + CE —
+test-pinned against that reference on a multi-device mesh.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+from ..utils.compat import shard_map_unchecked
+from .arcface import _l2_normalize, margin_splice
+
+
+def _local_margin_logits(features, w_local, labels, offset, s, m, easy_margin):
+    """(B, C_local) arc-margin logits for one class shard; margin applied
+    only on rows whose label falls inside [offset, offset + C_local).
+    Margin math is ops/arcface.py::margin_splice — one implementation for
+    the dense and sharded paths."""
+    cosine = _l2_normalize(features.astype(jnp.float32), 1) @ _l2_normalize(
+        w_local.astype(jnp.float32), 1).T                     # (B, C_local)
+    c_local = w_local.shape[0]
+    local = labels - offset                                   # (B,)
+    owned = (local >= 0) & (local < c_local)
+    one_hot = (jax.nn.one_hot(jnp.clip(local, 0, c_local - 1), c_local,
+                              dtype=jnp.float32)
+               * owned[:, None].astype(jnp.float32))
+    return margin_splice(cosine, one_hot, s, m, easy_margin), one_hot
+
+
+def arc_margin_ce_sharded(
+    features: jnp.ndarray,
+    weight: jnp.ndarray,
+    labels: jnp.ndarray,
+    mesh: Mesh,
+    class_axis: str,
+    batch_axis: Optional[str] = None,
+    s: float = 30.0,
+    m: float = 0.5,
+    easy_margin: bool = False,
+    topk: int = 3,
+) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """Exact mean softmax-CE over arc-margin logits, class dim sharded.
+
+    features: (B, D); weight: (C, D) with C divisible by the `class_axis`
+    size; labels: (B,) int32. Returns replicated scalars
+    (loss, top1_count, topk_count) over the GLOBAL batch — identical values
+    to `CE(arc_margin_logits(...), labels)` + rank-count metrics, without a
+    (B, C) tensor on any device.
+    """
+    mp = mesh.shape[class_axis]
+    c = weight.shape[0]
+    if c % mp:
+        raise ValueError(f"num_classes {c} not divisible by class-axis size {mp}")
+    b_global = features.shape[0]
+
+    def body(feat, w_local, labels):
+        idx = jax.lax.axis_index(class_axis)
+        c_local = w_local.shape[0]
+        offset = idx * c_local
+        logits, one_hot = _local_margin_logits(
+            feat, w_local, labels, offset, s, m, easy_margin)
+
+        # online softmax over the class axis: pmax → shifted psum. The max
+        # shift is gradient-neutral (∂lse/∂mx ≡ 0), and pmax has no
+        # differentiation rule — stop_gradient is exact, not an
+        # approximation.
+        mx = jax.lax.pmax(
+            jax.lax.stop_gradient(jnp.max(logits, axis=1)), class_axis)
+        lse = jnp.log(jax.lax.psum(
+            jnp.sum(jnp.exp(logits - mx[:, None]), axis=1), class_axis)) + mx
+        target = jax.lax.psum(jnp.sum(logits * one_hot, axis=1), class_axis)
+        loss_sum = jnp.sum(lse - target)
+
+        # top-k: per-shard candidates (values + GLOBAL class ids), merged by
+        # a (B, k·mp) all-gather — k·mp scalars per row, not C
+        k = min(topk, c_local)
+        val, pos = jax.lax.top_k(logits, k)                      # (B, k)
+        cand_v = jax.lax.all_gather(val, class_axis, axis=1)     # (B, mp, k)
+        cand_i = jax.lax.all_gather(pos + offset, class_axis, axis=1)
+        cand_v = cand_v.reshape(val.shape[0], -1)
+        cand_i = cand_i.reshape(val.shape[0], -1)
+        _, sel = jax.lax.top_k(cand_v, topk)                     # (B, topk)
+        picked = jnp.take_along_axis(cand_i, sel, axis=1)
+        hits = picked == labels[:, None]
+        top1 = jnp.sum(hits[:, :1])
+        topn = jnp.sum(hits)
+
+        if batch_axis is not None:
+            loss_sum = jax.lax.psum(loss_sum, batch_axis)
+            top1 = jax.lax.psum(top1, batch_axis)
+            topn = jax.lax.psum(topn, batch_axis)
+        return (loss_sum / b_global, top1.astype(jnp.float32),
+                topn.astype(jnp.float32))
+
+    b_spec = P(batch_axis) if batch_axis else P()
+    f = shard_map_unchecked(
+        body, mesh=mesh,
+        in_specs=(P(batch_axis, None) if batch_axis else P(None, None),
+                  P(class_axis, None), b_spec),
+        out_specs=(P(), P(), P()),
+    )
+    return f(features, weight, labels)

@@ -150,7 +150,8 @@ class Trainer:
         self.model, self.tx, self.state = create_train_state(
             cfg, self.mesh, self.steps_per_epoch)
 
-        self.train_step = make_train_step(cfg, self.model, self.tx)
+        self.train_step = make_train_step(cfg, self.model, self.tx,
+                                          mesh=self.mesh)
         self.eval_step = make_eval_step(cfg, self.model)
         self.nested_eval_step = (
             make_nested_eval_step(cfg, self.model)

@@ -61,13 +61,34 @@ def make_train_step(
     model: Any,
     tx: optax.GradientTransformationExtraArgs,
     base_rng: Optional[jax.Array] = None,
+    mesh: Optional[Any] = None,
 ) -> Callable[[TrainState, jnp.ndarray, jnp.ndarray], Tuple[TrainState, Dict[str, jnp.ndarray]]]:
     """One jitted `(state, images, labels) -> (state, metrics)` for the
     workload in `cfg` (baseline/cdr: plain CE; arcface: margin logits;
-    nested: per-batch prefix mask k ~ Gaussian, NESTED/train.py:247-250)."""
+    nested: per-batch prefix mask k ~ Gaussian, NESTED/train.py:247-250).
+
+    With `parallel.arcface_sharded_ce` (and a model axis > 1), the ArcFace
+    loss runs the partial-FC path: embeddings + class-sharded weight feed
+    `ops.sharded_head.arc_margin_ce_sharded`, so no (B, C) logits exist —
+    `mesh` is required for that mode."""
+    from ..parallel.mesh import MODEL_AXIS
+
     workload = cfg.model.head
     if base_rng is None:
         base_rng = jax.random.PRNGKey(cfg.run.seed + 1)
+
+    if cfg.parallel.arcface_sharded_ce and workload == "arcface":
+        # The flag exists to avoid (B, C) logits; silently falling back to
+        # the dense path would defeat it (and OOM at the scale it targets).
+        if (mesh is None or MODEL_AXIS not in mesh.axis_names
+                or mesh.shape[MODEL_AXIS] <= 1):
+            raise ValueError(
+                "arcface_sharded_ce requires a mesh with a model axis > 1 "
+                "(--mp N); got "
+                + ("no mesh" if mesh is None else f"mesh {dict(mesh.shape)}"))
+        loss_fn, metrics_fn = _arcface_sharded_loss(cfg, model, mesh)
+        return _build_step(tx, base_rng, loss_fn, metrics_fn)
+
     if workload == "nested":
         dist = jnp.asarray(gaussian_dist(0.0, cfg.model.nested_std, feat_dim_for(cfg.model)))
         feat_dim = feat_dim_for(cfg.model)
@@ -87,9 +108,19 @@ def make_train_step(
         loss = _cross_entropy(logits, labels)
         return loss, (mutated.get("batch_stats", batch_stats), logits)
 
+    return _build_step(tx, base_rng, loss_fn,
+                       lambda loss, logits, labels: _train_metrics(loss, logits, labels))
+
+
+def _build_step(tx, base_rng, loss_fn, metrics_fn):
+    """Shared optimizer-update skeleton for every train step: fold_in rng,
+    value_and_grad over `loss_fn(params, stats, images, labels, rng) ->
+    (loss, (new_stats, aux))`, apply updates, metrics via
+    `metrics_fn(loss, aux, labels)`."""
+
     def step(state: TrainState, images: jnp.ndarray, labels: jnp.ndarray):
         rng = jax.random.fold_in(base_rng, state.step)
-        (loss, (new_stats, logits)), grads = jax.value_and_grad(loss_fn, has_aux=True)(
+        (loss, (new_stats, aux)), grads = jax.value_and_grad(loss_fn, has_aux=True)(
             state.params, state.batch_stats, images, labels, rng
         )
         updates, new_opt = tx.update(grads, state.opt_state, state.params)
@@ -100,11 +131,40 @@ def make_train_step(
             batch_stats=new_stats,
             opt_state=new_opt,
         )
-        return new_state, _train_metrics(loss, logits, labels)
+        return new_state, metrics_fn(loss, aux, labels)
 
     return jax.jit(step, donate_argnums=0)
 
 
+def _arcface_sharded_loss(cfg, model, mesh):
+    """Partial-FC ArcFace loss/metrics pair: backbone embeddings + class-
+    sharded margin weight → `arc_margin_ce_sharded` (loss and top-k counts
+    in one shard_map, no (B, C) logits). Same observable contract as the
+    dense step, including the dense path's dropout-rng derivation."""
+    from ..ops.sharded_head import arc_margin_ce_sharded
+    from ..parallel.mesh import DATA_AXIS, MODEL_AXIS
+
+    mc = cfg.model
+    batch_axis = DATA_AXIS if mesh.shape[DATA_AXIS] > 1 else None
+
+    def loss_fn(params, batch_stats, images, labels, rng):
+        variables = {"params": params, "batch_stats": batch_stats}
+        _, drop_rng = jax.random.split(rng)  # same derivation as dense path
+        emb, mutated = model.apply(
+            variables, images, train=True, mutable=["batch_stats"],
+            rngs={"dropout": drop_rng}, method="features")
+        loss, t1, t3 = arc_margin_ce_sharded(
+            emb, params["margin"]["weight"], labels, mesh, MODEL_AXIS,
+            batch_axis=batch_axis, s=mc.arc_s, m=mc.arc_m,
+            easy_margin=mc.arc_easy_margin)
+        return loss, (mutated.get("batch_stats", batch_stats), (t1, t3))
+
+    def metrics_fn(loss, aux, labels):
+        t1, t3 = aux
+        n = labels.shape[0]
+        return {"loss": loss, "top1": t1 / n, "top3": t3 / n}
+
+    return loss_fn, metrics_fn
 
 
 def make_eval_step(
