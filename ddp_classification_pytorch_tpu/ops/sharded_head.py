@@ -68,6 +68,7 @@ def arc_margin_ce_sharded(
     m: float = 0.5,
     easy_margin: bool = False,
     topk: int = 3,
+    valid: Optional[jnp.ndarray] = None,
 ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
     """Exact mean softmax-CE over arc-margin logits, class dim sharded.
 
@@ -76,14 +77,22 @@ def arc_margin_ce_sharded(
     (loss, top1_count, topk_count) over the GLOBAL batch — identical values
     to `CE(arc_margin_logits(...), labels)` + rank-count metrics, without a
     (B, C) tensor on any device.
+
+    `valid` (B,) 0/1 masks loader wrap-padding (eval): masked rows drop out
+    of the loss numerator and the counts, and the loss denominator becomes
+    Σ valid instead of B. With m=0 the logits reduce to s·cosθ — exactly
+    the inference scores the eval path uses (ARCFACE eval semantics), so
+    one op serves train (margin) and eval (no margin + valid mask).
     """
     mp = mesh.shape[class_axis]
     c = weight.shape[0]
     if c % mp:
         raise ValueError(f"num_classes {c} not divisible by class-axis size {mp}")
     b_global = features.shape[0]
+    if valid is None:
+        valid = jnp.ones((b_global,), jnp.float32)
 
-    def body(feat, w_local, labels):
+    def body(feat, w_local, labels, valid):
         idx = jax.lax.axis_index(class_axis)
         c_local = w_local.shape[0]
         offset = idx * c_local
@@ -99,7 +108,7 @@ def arc_margin_ce_sharded(
         lse = jnp.log(jax.lax.psum(
             jnp.sum(jnp.exp(logits - mx[:, None]), axis=1), class_axis)) + mx
         target = jax.lax.psum(jnp.sum(logits * one_hot, axis=1), class_axis)
-        loss_sum = jnp.sum(lse - target)
+        loss_sum = jnp.sum((lse - target) * valid)
 
         # top-k: per-shard candidates (values + GLOBAL class ids), merged by
         # a (B, k·mp) all-gather — k·mp scalars per row, not C
@@ -111,22 +120,29 @@ def arc_margin_ce_sharded(
         cand_i = cand_i.reshape(val.shape[0], -1)
         _, sel = jax.lax.top_k(cand_v, topk)                     # (B, topk)
         picked = jnp.take_along_axis(cand_i, sel, axis=1)
-        hits = picked == labels[:, None]
+        # rows with any non-finite logit count as misses — the dense metric
+        # path (utils/metrics.py::topk_hits) applies the same guard so a
+        # diverged model can't report healthy top-k next to a NaN loss
+        finite = (jax.lax.psum(
+            jnp.sum(~jnp.isfinite(logits), axis=1), class_axis) == 0)
+        hits = (picked == labels[:, None]) * valid[:, None] * finite[:, None]
         top1 = jnp.sum(hits[:, :1])
         topn = jnp.sum(hits)
+        n = jnp.sum(valid)
 
         if batch_axis is not None:
             loss_sum = jax.lax.psum(loss_sum, batch_axis)
             top1 = jax.lax.psum(top1, batch_axis)
             topn = jax.lax.psum(topn, batch_axis)
-        return (loss_sum / b_global, top1.astype(jnp.float32),
+            n = jax.lax.psum(n, batch_axis)
+        return (loss_sum / jnp.maximum(n, 1.0), top1.astype(jnp.float32),
                 topn.astype(jnp.float32))
 
     b_spec = P(batch_axis) if batch_axis else P()
     f = shard_map_unchecked(
         body, mesh=mesh,
         in_specs=(P(batch_axis, None) if batch_axis else P(None, None),
-                  P(class_axis, None), b_spec),
+                  P(class_axis, None), b_spec, b_spec),
         out_specs=(P(), P(), P()),
     )
-    return f(features, weight, labels)
+    return f(features, weight, labels, valid)

@@ -152,7 +152,7 @@ class Trainer:
 
         self.train_step = make_train_step(cfg, self.model, self.tx,
                                           mesh=self.mesh)
-        self.eval_step = make_eval_step(cfg, self.model)
+        self.eval_step = make_eval_step(cfg, self.model, mesh=self.mesh)
         self.nested_eval_step = (
             make_nested_eval_step(cfg, self.model)
             if cfg.model.head == "nested" else None

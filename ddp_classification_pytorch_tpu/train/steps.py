@@ -78,14 +78,7 @@ def make_train_step(
         base_rng = jax.random.PRNGKey(cfg.run.seed + 1)
 
     if cfg.parallel.arcface_sharded_ce and workload == "arcface":
-        # The flag exists to avoid (B, C) logits; silently falling back to
-        # the dense path would defeat it (and OOM at the scale it targets).
-        if (mesh is None or MODEL_AXIS not in mesh.axis_names
-                or mesh.shape[MODEL_AXIS] <= 1):
-            raise ValueError(
-                "arcface_sharded_ce requires a mesh with a model axis > 1 "
-                "(--mp N); got "
-                + ("no mesh" if mesh is None else f"mesh {dict(mesh.shape)}"))
+        _require_sharded_ce_mesh(mesh)
         loss_fn, metrics_fn = _arcface_sharded_loss(cfg, model, mesh)
         return _build_step(tx, base_rng, loss_fn, metrics_fn)
 
@@ -110,6 +103,20 @@ def make_train_step(
 
     return _build_step(tx, base_rng, loss_fn,
                        lambda loss, logits, labels: _train_metrics(loss, logits, labels))
+
+
+def _require_sharded_ce_mesh(mesh) -> None:
+    """arcface_sharded_ce exists to avoid (B, C) logits; silently falling
+    back to the dense path would defeat it (and OOM at the scale it
+    targets) — one validation shared by the train and eval builders."""
+    from ..parallel.mesh import MODEL_AXIS
+
+    if (mesh is None or MODEL_AXIS not in mesh.axis_names
+            or mesh.shape[MODEL_AXIS] <= 1):
+        raise ValueError(
+            "arcface_sharded_ce requires a mesh with a model axis > 1 "
+            "(--mp N); got "
+            + ("no mesh" if mesh is None else f"mesh {dict(mesh.shape)}"))
 
 
 def _build_step(tx, base_rng, loss_fn, metrics_fn):
@@ -168,15 +175,22 @@ def _arcface_sharded_loss(cfg, model, mesh):
 
 
 def make_eval_step(
-    cfg: Config, model: Any
+    cfg: Config, model: Any, mesh: Optional[Any] = None
 ) -> Callable[..., Dict[str, jnp.ndarray]]:
     """`(state, images, labels, valid) -> {loss_sum, top1, top3, n}` —
     per-batch COUNTS over the rows where valid==1, summed exactly on host
     across batches. This replaces the reference's per-rank-shard metric
     scaled by world_size (BASELINE/main.py:247-249) with the exact global
     reduction; `valid` additionally masks the loader's wrap-padding so the
-    metrics are exact for any val-set size."""
+    metrics are exact for any val-set size.
+
+    With `parallel.arcface_sharded_ce` (and `mesh`), the ArcFace eval runs
+    the partial-FC path too: `arc_margin_ce_sharded` with m=0 yields
+    exactly the s·cosθ inference scores — no (B, C) logits in eval either."""
     workload = cfg.model.head
+    if workload == "arcface" and cfg.parallel.arcface_sharded_ce:
+        _require_sharded_ce_mesh(mesh)
+        return _make_arcface_sharded_eval(cfg, model, mesh)
 
     def step(state: TrainState, images: jnp.ndarray, labels: jnp.ndarray,
              valid: jnp.ndarray):
@@ -194,6 +208,28 @@ def make_eval_step(
             "top3": (topk_hits(logits, labels, 3) * valid).sum(),
             "n": valid.sum(),
         }
+
+    return jax.jit(step)
+
+
+def _make_arcface_sharded_eval(cfg, model, mesh):
+    """Partial-FC eval: m=0 in the sharded op gives s·cosθ scores; `valid`
+    masks wrap-padding inside the shard_map, so loss/counts stay exact."""
+    from ..ops.sharded_head import arc_margin_ce_sharded
+    from ..parallel.mesh import DATA_AXIS, MODEL_AXIS
+
+    mc = cfg.model
+    batch_axis = DATA_AXIS if mesh.shape[DATA_AXIS] > 1 else None
+
+    def step(state: TrainState, images: jnp.ndarray, labels: jnp.ndarray,
+             valid: jnp.ndarray):
+        variables = {"params": state.params, "batch_stats": state.batch_stats}
+        emb = model.apply(variables, images, train=False, method="features")
+        loss_mean, t1, t3 = arc_margin_ce_sharded(
+            emb, state.params["margin"]["weight"], labels, mesh, MODEL_AXIS,
+            batch_axis=batch_axis, s=mc.arc_s, m=0.0, valid=valid)
+        n = valid.sum()
+        return {"loss_sum": loss_mean * n, "top1": t1, "top3": t3, "n": n}
 
     return jax.jit(step)
 

@@ -130,3 +130,39 @@ def test_sharded_ce_flag_without_model_axis_raises():
             make_train_step(cfg, model, tx, mesh=mesh)
         with pytest.raises(ValueError, match="model axis"):
             make_train_step(cfg, model, tx)  # no mesh at all
+
+
+def test_arcface_sharded_eval_matches_dense_eval():
+    """Partial-FC eval (m=0 → s·cosθ scores, valid-masked) must produce the
+    same loss_sum/top-k counts as the dense eval step, including a
+    wrap-padded final batch."""
+    from ddp_classification_pytorch_tpu.config import get_preset
+    from ddp_classification_pytorch_tpu.train.state import create_train_state
+    from ddp_classification_pytorch_tpu.train.steps import make_eval_step
+
+    mesh = meshlib.make_mesh(meshlib.MeshSpec(2, 4))
+    rng = np.random.default_rng(1)
+    images = rng.normal(size=(8, 32, 32, 3)).astype(np.float32)
+    labels = rng.integers(0, 16, 8).astype(np.int32)
+    valid = np.array([1, 1, 1, 1, 1, 1, 0, 0], np.float32)  # padded tail
+
+    results = {}
+    for name, flag in (("dense", False), ("sharded", True)):
+        cfg = get_preset("arcface")
+        cfg.data.image_size = 32
+        cfg.data.num_classes = 16
+        cfg.data.batch_size = 8
+        cfg.model.arch = "resnet18"
+        cfg.model.variant = "cifar"
+        cfg.model.dtype = "float32"
+        cfg.parallel.arcface_sharded_ce = flag
+        with mesh:
+            model, tx, state = create_train_state(cfg, mesh, steps_per_epoch=4)
+            ev = make_eval_step(cfg, model, mesh=mesh)
+            x = jax.device_put(images, meshlib.batch_sharding(mesh))
+            y = jax.device_put(labels, meshlib.batch_sharding(mesh))
+            m = jax.device_put(valid, meshlib.batch_sharding(mesh))
+            results[name] = {k: float(v) for k, v in ev(state, x, y, m).items()}
+    for k in ("loss_sum", "top1", "top3", "n"):
+        np.testing.assert_allclose(
+            results["sharded"][k], results["dense"][k], atol=1e-4)
