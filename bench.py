@@ -23,7 +23,6 @@ import sys
 import time
 
 import jax
-import jax.numpy as jnp
 import numpy as np
 
 A100_RESNET50_IMG_PER_SEC = 2500.0

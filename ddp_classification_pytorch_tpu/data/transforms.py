@@ -18,7 +18,7 @@ native conv layout; the reference's NCHW is a torch convention, not copied.
 from __future__ import annotations
 
 import dataclasses
-from typing import Callable, Tuple
+from typing import Tuple
 
 import numpy as np
 from PIL import Image

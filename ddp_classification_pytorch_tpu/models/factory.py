@@ -4,7 +4,7 @@ CDR/main.py:330-338, NESTED/train.py:345-349)."""
 
 from __future__ import annotations
 
-from typing import Any, Optional, Tuple
+from typing import Any, Optional
 
 import flax.linen as nn
 import jax.numpy as jnp

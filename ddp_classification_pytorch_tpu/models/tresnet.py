@@ -22,7 +22,7 @@ Architecture", Ridnik et al. 2020), re-derived for NHWC/XLA:
 from __future__ import annotations
 
 import functools
-from typing import Any, Optional, Sequence
+from typing import Any, Sequence
 
 import flax.linen as nn
 import jax.numpy as jnp

@@ -17,7 +17,6 @@ order (test_collectives.py asserts closeness).
 
 from __future__ import annotations
 
-from functools import partial
 from typing import Any, Callable, Dict, Optional, Tuple
 
 import jax

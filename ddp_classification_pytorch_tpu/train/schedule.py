@@ -17,7 +17,6 @@ jitted update — no host round-trip per step.
 
 from __future__ import annotations
 
-from typing import Callable, Optional
 
 import jax
 import jax.numpy as jnp

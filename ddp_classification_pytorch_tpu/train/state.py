@@ -25,7 +25,7 @@ import optax
 from flax import struct
 
 from ..config import Config
-from ..models.factory import build_model, feat_dim_for
+from ..models.factory import build_model
 from ..parallel import mesh as meshlib
 from .schedule import build_optimizer
 

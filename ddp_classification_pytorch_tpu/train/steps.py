@@ -71,8 +71,6 @@ def make_train_step(
     loss runs the partial-FC path: embeddings + class-sharded weight feed
     `ops.sharded_head.arc_margin_ce_sharded`, so no (B, C) logits exist —
     `mesh` is required for that mode."""
-    from ..parallel.mesh import MODEL_AXIS
-
     workload = cfg.model.head
     if base_rng is None:
         base_rng = jax.random.PRNGKey(cfg.run.seed + 1)

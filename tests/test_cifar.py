@@ -1,6 +1,5 @@
 """CIFAR local-pickle dataset tests (synthesized pickle files)."""
 
-import os
 import pickle
 
 import numpy as np

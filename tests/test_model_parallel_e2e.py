@@ -3,7 +3,6 @@
 
 import numpy as np
 
-import jax
 
 from ddp_classification_pytorch_tpu.config import get_preset
 from ddp_classification_pytorch_tpu.parallel.mesh import MODEL_AXIS

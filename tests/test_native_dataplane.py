@@ -1,7 +1,6 @@
 """Native C++ dataplane tests: builds the shared lib, decodes real JPEGs, and
 checks transform semantics against the Python/PIL pipeline."""
 
-import os
 
 import numpy as np
 import pytest

@@ -6,7 +6,6 @@ on 8 devices — which is the test strategy the reference lacks entirely
 """
 
 import numpy as np
-import pytest
 
 import jax
 
