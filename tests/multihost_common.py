@@ -1,0 +1,43 @@
+"""Shared step-runner for the multi-host test and its single-process oracle.
+
+`run_steps` builds the baseline workload's real train step and runs it on a
+fixed, seeded 16-row global batch; callers pass the row slice this host
+contributes (`make_global_array` stitches the rest from the other hosts).
+The losses must be bit-comparable between a 2-process run and a
+single-process 8-device run — multi-host changes WHERE shards live, not the
+math.
+"""
+
+from typing import List
+
+
+def run_steps(mesh, host_rows: slice, steps: int = 3) -> List[float]:
+    import numpy as np
+
+    from ddp_classification_pytorch_tpu.config import get_preset
+    from ddp_classification_pytorch_tpu.parallel import mesh as meshlib
+    from ddp_classification_pytorch_tpu.train.state import create_train_state
+    from ddp_classification_pytorch_tpu.train.steps import make_train_step
+
+    cfg = get_preset("baseline")
+    cfg.data.image_size = 32
+    cfg.data.num_classes = 4
+    cfg.data.batch_size = 16
+    cfg.model.arch = "resnet18"
+    cfg.model.variant = "cifar"
+    cfg.model.dtype = "float32"
+
+    rng = np.random.default_rng(3)
+    images = rng.normal(size=(16, 32, 32, 3)).astype(np.float32)
+    labels = rng.integers(0, 4, 16).astype(np.int32)
+
+    with mesh:
+        model, tx, state = create_train_state(cfg, mesh, steps_per_epoch=4)
+        step = make_train_step(cfg, model, tx, mesh=mesh)
+        batch = meshlib.make_global_array(
+            (images[host_rows], labels[host_rows]), mesh)
+        losses = []
+        for _ in range(steps):
+            state, metrics = step(state, *batch)
+            losses.append(float(metrics["loss"]))
+    return losses

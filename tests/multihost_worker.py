@@ -1,0 +1,54 @@
+"""Multi-host worker for tests/test_multihost.py (not a test module).
+
+Each process owns 4 virtual CPU devices; `jax.distributed.initialize` joins
+them into one 8-device platform — the same SPMD program a 2-host TPU pod
+runs, with gloo standing in for DCN. The worker drives the PRODUCT path:
+`make_mesh` over global devices, `make_global_array` from this host's slice
+of a fixed global batch, and the jitted `make_train_step`. Host 0 writes the
+per-step losses to the output file for the parent to compare against a
+single-process run of the identical global batch.
+"""
+
+import json
+import os
+import sys
+
+
+def main() -> None:
+    pid, nprocs, port, out = (int(sys.argv[1]), int(sys.argv[2]),
+                              sys.argv[3], sys.argv[4])
+    os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    jax.distributed.initialize(f"localhost:{port}", num_processes=nprocs,
+                               process_id=pid)
+    import numpy as np
+
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    from multihost_common import run_steps
+
+    from ddp_classification_pytorch_tpu.data.loader import shard_indices_for_host
+    from ddp_classification_pytorch_tpu.parallel import mesh as meshlib
+
+    assert jax.process_count() == nprocs and jax.local_device_count() == 4
+
+    # per-host dataset sharding sanity: hosts take disjoint, covering shards
+    shards = [
+        shard_indices_for_host(64, epoch=0, seed=7, batch_size=8,
+                               host_id=h, num_hosts=nprocs)
+        for h in range(nprocs)
+    ]
+    flat = np.concatenate(shards)
+    assert len(set(flat.tolist())) == 64, "host shards must cover the dataset"
+    assert all(len(s) == 64 // nprocs for s in shards), "equal host shards"
+
+    mesh = meshlib.make_mesh()
+    losses = run_steps(mesh, host_rows=slice(pid * 8, (pid + 1) * 8))
+    if jax.process_index() == 0:
+        with open(out, "w") as f:
+            json.dump({"losses": losses}, f)
+
+
+if __name__ == "__main__":
+    main()
