@@ -143,6 +143,12 @@ def build_parser() -> argparse.ArgumentParser:
     par.add_argument("--dcn_slices", type=int, default=0,
                      help="multi-slice pods: two-tier mesh with DP across "
                           "N DCN-connected slices, model axis on ICI")
+    par.add_argument("--moe_experts", type=int, default=0,
+                     help="ViT: dropless split-FFN mixture-of-experts with "
+                          "N experts per block; with --mp > 1 the experts "
+                          "shard over the model axis (expert parallelism)")
+    par.add_argument("--moe_top_k", type=int, default=2,
+                     help="router top-k for --moe_experts")
     par.add_argument("--sharded_ce", action="store_true",
                      help="arcface: partial-FC loss — class-sharded "
                           "softmax-CE over the model axis, no (B, C) "
@@ -284,6 +290,9 @@ def config_from_args(args: argparse.Namespace) -> Config:
         cfg.parallel.dcn_slices = args.dcn_slices
     if args.sharded_ce:
         cfg.parallel.arcface_sharded_ce = True
+    if args.moe_experts:
+        cfg.model.moe_experts = args.moe_experts
+        cfg.model.moe_top_k = args.moe_top_k
     return cfg
 
 

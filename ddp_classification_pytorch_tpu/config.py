@@ -81,6 +81,12 @@ class ModelConfig:
     dropout: float = 0.0
     dtype: str = "bfloat16"  # compute dtype; params and BN stats stay f32
     remat: bool = False  # per-block rematerialization (activation-memory lever)
+    # ViT family: dropless split-FFN mixture-of-experts in every block
+    # (ops/moe.py); >0 enables it. Experts shard over the mesh `model` axis
+    # (expert parallelism) — the axis serves one role per config, so this
+    # excludes ring-SP/PP for the same run.
+    moe_experts: int = 0
+    moe_top_k: int = 2
     # ViT family: use the Pallas streaming flash-attention kernel for the
     # unsharded attention path (ops/flash_attention.py); ring-sharded
     # attention ignores it

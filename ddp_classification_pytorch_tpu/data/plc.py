@@ -143,3 +143,39 @@ def build_annotations(
         with open(os.path.join(out_dir, f"{split}_key_list.txt"), "w") as f:
             for i in split_idx.get(split, []):
                 f.write(keys[int(i)] + "\n")
+
+
+def check_bad_images(
+    image_root: str,
+    keys: Optional[Sequence[str]] = None,
+    num_workers: int = 8,
+) -> List[str]:
+    """Find undecodable/corrupt images under `image_root`.
+
+    The reference's `check_bad_image` (PLC/FolderDataset.py:156-184) walks a
+    hardcoded absolute path and prints offenders; this version takes the
+    root (and optionally an explicit key list, e.g. a split's
+    `*_key_list.txt` contents), verifies each file actually decodes to RGB,
+    and returns the bad relative paths — callable from cleanup scripts or
+    ahead of a long run. Decodes run on a thread pool (PIL releases the GIL
+    in the codec)."""
+    from concurrent.futures import ThreadPoolExecutor
+
+    from PIL import Image
+
+    if keys is None:
+        from .imagefolder import scan_image_folder
+
+        paths, _, _ = scan_image_folder(image_root)
+        keys = [os.path.relpath(p, image_root) for p in paths]
+
+    def probe(key: str) -> Optional[str]:
+        try:
+            with Image.open(os.path.join(image_root, key)) as im:
+                im.convert("RGB").load()
+            return None
+        except Exception:
+            return key
+
+    with ThreadPoolExecutor(max(num_workers, 1)) as ex:
+        return [k for k in ex.map(probe, keys) if k is not None]

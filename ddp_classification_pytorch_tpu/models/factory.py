@@ -63,11 +63,17 @@ def build_backbone(cfg: ModelConfig, num_classes: int = 0,
         # lazy: parallel/__init__ imports this module (collectives → factory)
         from ..parallel.mesh import MODEL_AXIS
 
-        seq = MODEL_AXIS if (mesh is not None and mesh.shape.get(MODEL_AXIS, 1) > 1) else None
+        mp = mesh.shape.get(MODEL_AXIS, 1) if mesh is not None else 1
+        # the model axis serves ONE role per config: EP when MoE is on,
+        # ring-SP otherwise
+        moe_axis = MODEL_AXIS if (cfg.moe_experts > 0 and mp > 1) else None
+        seq = MODEL_AXIS if (mp > 1 and not cfg.moe_experts) else None
         return _vit.build_vit(
             cfg.arch, num_classes=num_classes, dtype=dtype,
-            dropout=cfg.dropout, mesh=mesh if seq else None, seq_axis=seq,
-            remat=cfg.remat, use_flash=cfg.flash_attention,
+            dropout=cfg.dropout, mesh=mesh if (seq or moe_axis) else None,
+            seq_axis=seq, remat=cfg.remat, use_flash=cfg.flash_attention,
+            moe_experts=cfg.moe_experts, moe_top_k=cfg.moe_top_k,
+            moe_axis=moe_axis,
         )
     raise ValueError(f"unknown arch {cfg.arch!r}")
 
@@ -140,6 +146,11 @@ def build_model(cfg: ModelConfig, num_classes: int,
             raise ValueError(
                 "pipeline parallelism does not support dropout (the tick "
                 "loop carries no per-tick rng); set --dropout 0")
+        if cfg.moe_experts:
+            raise ValueError(
+                "pipeline parallelism and moe_experts both claim the model "
+                "axis — one role per config (drop --pp_microbatches or "
+                "--moe_experts)")
         return GPipeViT(
             cfg.arch, num_classes, mesh, pipeline_microbatches,
             dtype=jnp.dtype(cfg.dtype), axis_name=MODEL_AXIS, remat=cfg.remat)

@@ -67,7 +67,10 @@ class MHA(nn.Module):
 
 
 class Block(nn.Module):
-    """Pre-LN transformer block: LN→MHA→res, LN→MLP(4×, GELU)→res."""
+    """Pre-LN transformer block: LN→MHA→res, LN→FFN→res. The FFN is either
+    the standard MLP(4×, GELU) or, with `moe_experts` > 0, a dropless
+    split-FFN mixture-of-experts (ops/moe.py) whose experts shard over the
+    mesh `moe_axis` — expert parallelism."""
 
     dim: int
     heads: int
@@ -76,6 +79,9 @@ class Block(nn.Module):
     mesh: Optional[Any] = None
     seq_axis: Optional[str] = None
     use_flash: bool = False
+    moe_experts: int = 0
+    moe_top_k: int = 2
+    moe_axis: Optional[str] = None
 
     @nn.compact
     def __call__(self, x: jnp.ndarray, train: bool = True) -> jnp.ndarray:
@@ -83,11 +89,43 @@ class Block(nn.Module):
         x = x + MHA(self.dim, self.heads, self.dtype, self.mesh,
                     self.seq_axis, self.use_flash, name="attn")(y)
         y = nn.LayerNorm(dtype=jnp.float32, name="ln2")(x).astype(self.dtype)
-        y = nn.Dense(4 * self.dim, dtype=self.dtype, name="mlp_in")(y)
-        y = nn.gelu(y)
-        if self.dropout:
-            y = nn.Dropout(self.dropout, deterministic=not train)(y)
-        y = nn.Dense(self.dim, dtype=self.dtype, name="mlp_out")(y)
+        if self.moe_experts > 0:
+            from ..ops.moe import moe_mlp
+            from ..parallel.mesh import DATA_AXIS
+
+            e = self.moe_experts
+            if self.dropout:
+                raise ValueError(
+                    "moe_experts does not support dropout (the expert mix "
+                    "has no dropout slot); set --dropout 0")
+            if (4 * self.dim) % e:
+                raise ValueError(
+                    f"moe_experts={e} must divide the FFN hidden width "
+                    f"{4 * self.dim} (split-FFN param/FLOP parity)")
+            hidden = (4 * self.dim) // e  # split-FFN: total params/FLOPs
+            # match the dense MLP; routing redistributes capacity
+            init = nn.initializers.xavier_uniform()
+            router = self.param("moe_router", init, (self.dim, e), jnp.float32)
+            w_in = self.param("moe_w_in", init, (e, self.dim, hidden), jnp.float32)
+            b_in = self.param("moe_b_in", nn.initializers.zeros, (e, hidden), jnp.float32)
+            w_out = self.param("moe_w_out", init, (e, hidden, self.dim), jnp.float32)
+            b_out = self.param("moe_b_out", nn.initializers.zeros, (e, self.dim), jnp.float32)
+            # batch sharding only when it divides (model.init's 2-sample
+            # dummy batch doesn't; correctness never depends on it)
+            dp = (self.mesh.shape.get(DATA_AXIS, 1)
+                  if self.mesh is not None else 1)
+            batch_axis = (DATA_AXIS
+                          if dp > 1 and y.shape[0] % dp == 0 else None)
+            y = moe_mlp(y, router, w_in, b_in, w_out, b_out,
+                        top_k=self.moe_top_k, dtype=self.dtype,
+                        mesh=self.mesh if self.moe_axis else None,
+                        axis=self.moe_axis, batch_axis=batch_axis)
+        else:
+            y = nn.Dense(4 * self.dim, dtype=self.dtype, name="mlp_in")(y)
+            y = nn.gelu(y)
+            if self.dropout:
+                y = nn.Dropout(self.dropout, deterministic=not train)(y)
+            y = nn.Dense(self.dim, dtype=self.dtype, name="mlp_out")(y)
         return x + y
 
 
@@ -110,6 +148,9 @@ class ViT(nn.Module):
     seq_axis: Optional[str] = None
     remat: bool = False
     use_flash: bool = False
+    moe_experts: int = 0
+    moe_top_k: int = 2
+    moe_axis: Optional[str] = None
 
     @nn.compact
     def __call__(self, x: jnp.ndarray, train: bool = True) -> jnp.ndarray:
@@ -127,6 +168,7 @@ class ViT(nn.Module):
         for i in range(self.depth):
             x = block_cls(self.dim, self.heads, self.dtype, self.dropout,
                           self.mesh, self.seq_axis, self.use_flash,
+                          self.moe_experts, self.moe_top_k, self.moe_axis,
                           name=f"block{i}")(x, train)
         x = nn.LayerNorm(dtype=jnp.float32, name="ln_final")(x)
         x = x.mean(axis=1)  # token mean-pool; shard-friendly (see module doc)
@@ -139,9 +181,11 @@ class ViT(nn.Module):
 def build_vit(arch: str, num_classes: int = 0, dtype: Any = jnp.bfloat16,
               dropout: float = 0.0, mesh: Optional[Any] = None,
               seq_axis: Optional[str] = None, remat: bool = False,
-              use_flash: bool = False) -> ViT:
+              use_flash: bool = False, moe_experts: int = 0,
+              moe_top_k: int = 2, moe_axis: Optional[str] = None) -> ViT:
     patch, dim, depth, heads = VIT_CONFIGS[arch]
     return ViT(patch=patch, dim=dim, depth=depth, heads=heads,
                num_classes=num_classes, dtype=dtype, dropout=dropout,
                mesh=mesh, seq_axis=seq_axis, remat=remat,
-               use_flash=use_flash)
+               use_flash=use_flash, moe_experts=moe_experts,
+               moe_top_k=moe_top_k, moe_axis=moe_axis)

@@ -1,0 +1,115 @@
+"""Expert-parallel mixture-of-experts FFN — dropless, exact, mesh-sharded.
+
+The reference has no MoE (SURVEY §2.2 lists EP as absent); this op extends
+the framework's parallelism pentad (DP / class-TP / ring-SP / GPipe-PP) with
+expert parallelism over the same `model` mesh axis. Design choices, TPU-
+first:
+
+- **Split-FFN experts**: the transformer block's 4·C-hidden MLP is split
+  into E experts of hidden H = 4·C/E each, so total parameters and dense
+  FLOPs match the standard block — routing redistributes capacity instead
+  of adding it.
+- **Dense dispatch, sparse gates**: every expert runs every token (one big
+  batched einsum on the MXU — no sorting, no capacity factor, no dropped
+  tokens); sparsity lives in the top-k router gates that weight the
+  combine. Exact by construction, static-shaped, and immune to the
+  load-balancing pathologies of capacity-based dispatch. The all-to-all
+  dispatch that skips non-routed FLOPs is the classic next optimization;
+  at split-FFN sizes the MXU prefers the dense batched matmul anyway.
+- **Expert parallelism**: under a >1 `model` axis, each device holds E/N
+  experts (leading-dim sharded params), computes their weighted outputs
+  for all tokens, and one `psum` over the axis completes the combine —
+  the EP collective. Tokens stay replicated along the model axis (the
+  axis serves ONE role per config: class-TP | SP | PP | EP).
+- Router math in f32 (softmax over expert logits); expert matmuls in the
+  model's compute dtype with f32 accumulation.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+from ..utils.compat import shard_map_unchecked
+
+
+def topk_gates(x: jnp.ndarray, router_w: jnp.ndarray, top_k: int) -> jnp.ndarray:
+    """(B, T, C) tokens → (B, T, E) gate weights: softmax over the top-k
+    router logits per token, zero elsewhere (renormalized sparse mixture)."""
+    e = router_w.shape[1]
+    if not 1 <= top_k <= e:
+        raise ValueError(f"top_k={top_k} must be in [1, num_experts={e}]")
+    logits = jnp.einsum("btc,ce->bte", x.astype(jnp.float32),
+                        router_w.astype(jnp.float32))
+    vals, idx = jax.lax.top_k(logits, top_k)              # (B, T, k)
+    w = jax.nn.softmax(vals, axis=-1)
+    onehot = jax.nn.one_hot(idx, e, dtype=jnp.float32)    # (B, T, k, E)
+    return jnp.einsum("btk,btke->bte", w, onehot)
+
+
+def _expert_mix(x, gates, w_in, b_in, w_out, b_out, dtype):
+    """Weighted sum of local experts' FFN outputs for all tokens.
+
+    x (B, T, C); gates (B, T, e_local); experts leading-dim e_local.
+    Returns (B, T, C) f32 partial combine (summed over local experts).
+    """
+    xc = x.astype(dtype)
+    h = jnp.einsum("btc,ech->beth", xc, w_in.astype(dtype),
+                   preferred_element_type=jnp.float32)
+    h = jax.nn.gelu(h + b_in[None, :, None, :])
+    y = jnp.einsum("beth,ehc->betc", h.astype(dtype), w_out.astype(dtype),
+                   preferred_element_type=jnp.float32)
+    y = y + b_out[None, :, None, :]
+    return jnp.einsum("betc,bte->btc", y, gates.astype(jnp.float32))
+
+
+def moe_mlp(
+    x: jnp.ndarray,
+    router_w: jnp.ndarray,
+    w_in: jnp.ndarray,
+    b_in: jnp.ndarray,
+    w_out: jnp.ndarray,
+    b_out: jnp.ndarray,
+    *,
+    top_k: int = 2,
+    dtype=jnp.bfloat16,
+    mesh: Optional[Mesh] = None,
+    axis: Optional[str] = None,
+    batch_axis: Optional[str] = None,
+) -> jnp.ndarray:
+    """Mixture-of-experts FFN, optionally expert-sharded over `axis`.
+
+    x: (B, T, C); router_w: (C, E); w_in: (E, C, H); b_in: (E, H);
+    w_out: (E, H, C); b_out: (E, C). Returns (B, T, C) in x.dtype.
+    Sharded and unsharded paths are numerically identical (test-pinned):
+    distribution decides where experts live, never the math.
+    """
+    n = mesh.shape[axis] if (mesh is not None and axis) else 1
+    if n <= 1:
+        gates = topk_gates(x, router_w, top_k)
+        out = _expert_mix(x, gates, w_in, b_in, w_out, b_out, dtype)
+        return out.astype(x.dtype)
+    e = w_in.shape[0]
+    if e % n:
+        raise ValueError(f"num experts {e} not divisible by axis size {n}")
+
+    def body(x, router_w, w_in, b_in, w_out, b_out):
+        idx = jax.lax.axis_index(axis)
+        e_local = w_in.shape[0]
+        gates = topk_gates(x, router_w, top_k)            # full (B, T, E)
+        g_local = jax.lax.dynamic_slice_in_dim(
+            gates, idx * e_local, e_local, axis=2)
+        part = _expert_mix(x, g_local, w_in, b_in, w_out, b_out, dtype)
+        return jax.lax.psum(part, axis)                   # EP combine
+
+    x_spec = P(batch_axis, None, None) if batch_axis else P(None, None, None)
+    f = shard_map_unchecked(
+        body, mesh=mesh,
+        in_specs=(x_spec, P(None, None), P(axis, None, None), P(axis, None),
+                  P(axis, None, None), P(axis, None)),
+        out_specs=x_spec,
+    )
+    return f(x, router_w, w_in, b_in, w_out, b_out).astype(x.dtype)

@@ -158,6 +158,12 @@ def _spec_for_param(path: str, value: Any, model_axis_size: int) -> P:
         return P()
     if "margin" in path and path.endswith("weight']") and value.ndim == 2:
         return P(MODEL_AXIS, None)
+    if "moe_" in path and "moe_router" not in path and (
+            value.shape[0] % model_axis_size == 0):
+        # MoE expert banks (E, ...): expert dim → expert-parallel shards
+        # (ops/moe.py); the router stays replicated (every token gates over
+        # every expert)
+        return P(*([MODEL_AXIS] + [None] * (value.ndim - 1)))
     if value.ndim == 2 and "kernel" in path and (
             "classifier" in path or "']['fc']" in path):
         return P(None, MODEL_AXIS)

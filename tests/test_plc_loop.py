@@ -128,3 +128,21 @@ def test_plc_auto_resume_restores_labels_and_delta(tmp_path):
     assert tr2.start_epoch == 1
     assert tr2.delta == delta_after
     np.testing.assert_array_equal(np.asarray(tr2.train_ds.labels), labels_after)
+
+
+def test_check_bad_images(tmp_path):
+    """Corrupt files are reported by relative path; good ones are not
+    (reference check_bad_image, PLC/FolderDataset.py:156-184)."""
+    import numpy as np
+    from PIL import Image
+
+    from ddp_classification_pytorch_tpu.data.plc import check_bad_images
+
+    root = tmp_path / "imgs"
+    (root / "cat").mkdir(parents=True)
+    Image.fromarray(
+        np.zeros((8, 8, 3), np.uint8)).save(root / "cat" / "good.jpg")
+    (root / "cat" / "bad.jpg").write_bytes(b"not a jpeg at all")
+    bad = check_bad_images(str(root))
+    import os
+    assert bad == [os.path.join("cat", "bad.jpg")]
