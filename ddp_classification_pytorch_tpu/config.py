@@ -87,6 +87,9 @@ class ModelConfig:
     # excludes ring-SP/PP for the same run.
     moe_experts: int = 0
     moe_top_k: int = 2
+    # Switch-style router load-balance penalty weight (ops/moe.py::
+    # load_balance_loss, sown per block, summed into the training loss)
+    moe_aux_weight: float = 0.01
     # ViT family: use the Pallas streaming flash-attention kernel for the
     # unsharded attention path (ops/flash_attention.py); ring-sharded
     # attention ignores it

@@ -48,6 +48,10 @@ def build_backbone(cfg: ModelConfig, num_classes: int = 0,
     sequence-parallel ring attention with tokens sharded over that axis;
     the CNN zoos ignore it (their parallelism is batch/class sharding)."""
     dtype = jnp.dtype(cfg.dtype)
+    if cfg.moe_experts and cfg.arch not in _vit.VIT_CONFIGS:
+        raise ValueError(
+            f"moe_experts requires a ViT arch (transformer FFN to split); "
+            f"got {cfg.arch!r}")
     if cfg.arch in _RESNETS:
         return _RESNETS[cfg.arch](
             num_classes=num_classes, variant=cfg.variant, dtype=dtype,

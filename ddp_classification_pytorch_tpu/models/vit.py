@@ -90,7 +90,12 @@ class Block(nn.Module):
                     self.seq_axis, self.use_flash, name="attn")(y)
         y = nn.LayerNorm(dtype=jnp.float32, name="ln2")(x).astype(self.dtype)
         if self.moe_experts > 0:
-            from ..ops.moe import moe_mlp
+            from ..ops.moe import (
+                load_balance_loss,
+                moe_mlp,
+                router_logits,
+                topk_gates,
+            )
             from ..parallel.mesh import DATA_AXIS
 
             e = self.moe_experts
@@ -116,8 +121,15 @@ class Block(nn.Module):
                   if self.mesh is not None else 1)
             batch_axis = (DATA_AXIS
                           if dp > 1 and y.shape[0] % dp == 0 else None)
-            y = moe_mlp(y, router, w_in, b_in, w_out, b_out,
-                        top_k=self.moe_top_k, dtype=self.dtype,
+            # one router evaluation feeds both the gates and the balance
+            # penalty (harvested by the train step via the 'losses'
+            # collection; sow accumulates across blocks)
+            logits = router_logits(y, router)
+            gates = topk_gates(logits, self.moe_top_k)
+            self.sow("losses", "moe_aux",
+                     load_balance_loss(logits, self.moe_top_k))
+            y = moe_mlp(y, gates, w_in, b_in, w_out, b_out,
+                        dtype=self.dtype,
                         mesh=self.mesh if self.moe_axis else None,
                         axis=self.moe_axis, batch_axis=batch_axis)
         else:

@@ -1,4 +1,4 @@
-from .arcface import arc_margin_logits, arcface_naive_log_logits
+from .arcface import arc_margin_logits, arcface_naive_log_logits, margin_splice
 from .nested import (
     best_k,
     gaussian_dist,
@@ -9,8 +9,10 @@ from .nested import (
 )
 from .attention import attention, ring_attention
 from .cdr import cdr_clip_schedule, cdr_gradient_transform
-from .flash_attention import flash_attention
+from .flash_attention import flash_attention, flash_attention_with_lse
 from .pipeline import gpipe
+from .moe import load_balance_loss, moe_mlp, router_logits, topk_gates
+from .sharded_head import arc_margin_ce_sharded
 from .labelnoise import (
     eta_approximation,
     label_noise,
@@ -21,7 +23,9 @@ from .pallas_kernels import batch_norm_leaky_relu, fused_bn_leaky_relu
 
 __all__ = [
     "attention", "ring_attention", "flash_attention", "gpipe",
-    "arc_margin_logits", "arcface_naive_log_logits",
+    "arc_margin_logits", "arcface_naive_log_logits", "margin_splice",
+    "arc_margin_ce_sharded", "moe_mlp", "topk_gates", "router_logits",
+    "load_balance_loss", "flash_attention_with_lse",
     "gaussian_dist", "sample_mask_dims", "prefix_mask",
     "nested_all_k_logits", "nested_all_k_counts", "best_k",
     "cdr_gradient_transform", "cdr_clip_schedule",

@@ -36,18 +36,42 @@ from jax.sharding import Mesh, PartitionSpec as P
 from ..utils.compat import shard_map_unchecked
 
 
-def topk_gates(x: jnp.ndarray, router_w: jnp.ndarray, top_k: int) -> jnp.ndarray:
-    """(B, T, C) tokens → (B, T, E) gate weights: softmax over the top-k
-    router logits per token, zero elsewhere (renormalized sparse mixture)."""
-    e = router_w.shape[1]
+def router_logits(x: jnp.ndarray, router_w: jnp.ndarray) -> jnp.ndarray:
+    """(B, T, C) tokens × (C, E) router → (B, T, E) f32 logits. Computed
+    ONCE per block; gates and the balance penalty both derive from it."""
+    return jnp.einsum("btc,ce->bte", x.astype(jnp.float32),
+                      router_w.astype(jnp.float32))
+
+
+def topk_gates(logits: jnp.ndarray, top_k: int) -> jnp.ndarray:
+    """(B, T, E) router logits → (B, T, E) gate weights: softmax over the
+    top-k logits per token, zero elsewhere (renormalized sparse mixture)."""
+    e = logits.shape[-1]
     if not 1 <= top_k <= e:
         raise ValueError(f"top_k={top_k} must be in [1, num_experts={e}]")
-    logits = jnp.einsum("btc,ce->bte", x.astype(jnp.float32),
-                        router_w.astype(jnp.float32))
     vals, idx = jax.lax.top_k(logits, top_k)              # (B, T, k)
     w = jax.nn.softmax(vals, axis=-1)
     onehot = jax.nn.one_hot(idx, e, dtype=jnp.float32)    # (B, T, k, E)
     return jnp.einsum("btk,btke->bte", w, onehot)
+
+
+def load_balance_loss(logits: jnp.ndarray, top_k: int) -> jnp.ndarray:
+    """Switch-Transformer-style router balance penalty, scalar ≥ ~1.
+
+    E · Σ_e f_e · p_e, where f_e is the fraction of tokens whose top-k set
+    contains expert e and p_e the mean full-softmax router probability of e.
+    Equals 1·top_k under a perfectly uniform router and grows as routing
+    collapses onto few experts; differentiable through p_e (f_e is a
+    stop-gradient count, the standard estimator). Dense dispatch makes
+    collapse a quality problem rather than a capacity-overflow problem —
+    this keeps the mixture diverse either way."""
+    e = logits.shape[-1]
+    probs = jax.nn.softmax(logits, axis=-1)               # (B, T, E)
+    _, idx = jax.lax.top_k(logits, top_k)
+    chosen = jax.nn.one_hot(idx, e, dtype=jnp.float32).sum(axis=2)  # (B,T,E)
+    f = jax.lax.stop_gradient(chosen.reshape(-1, e).mean(axis=0))
+    p = probs.reshape(-1, e).mean(axis=0)
+    return e * jnp.sum(f * p)
 
 
 def _expert_mix(x, gates, w_in, b_in, w_out, b_out, dtype):
@@ -68,13 +92,12 @@ def _expert_mix(x, gates, w_in, b_in, w_out, b_out, dtype):
 
 def moe_mlp(
     x: jnp.ndarray,
-    router_w: jnp.ndarray,
+    gates: jnp.ndarray,
     w_in: jnp.ndarray,
     b_in: jnp.ndarray,
     w_out: jnp.ndarray,
     b_out: jnp.ndarray,
     *,
-    top_k: int = 2,
     dtype=jnp.bfloat16,
     mesh: Optional[Mesh] = None,
     axis: Optional[str] = None,
@@ -82,24 +105,24 @@ def moe_mlp(
 ) -> jnp.ndarray:
     """Mixture-of-experts FFN, optionally expert-sharded over `axis`.
 
-    x: (B, T, C); router_w: (C, E); w_in: (E, C, H); b_in: (E, H);
-    w_out: (E, H, C); b_out: (E, C). Returns (B, T, C) in x.dtype.
-    Sharded and unsharded paths are numerically identical (test-pinned):
-    distribution decides where experts live, never the math.
+    x: (B, T, C); gates: (B, T, E) from `topk_gates` (computed once by the
+    caller, so the router einsum/top-k isn't re-evaluated inside the
+    shard_map); w_in: (E, C, H); b_in: (E, H); w_out: (E, H, C);
+    b_out: (E, C). Returns (B, T, C) in x.dtype. Sharded and unsharded
+    paths are numerically identical (test-pinned): distribution decides
+    where experts live, never the math.
     """
     n = mesh.shape[axis] if (mesh is not None and axis) else 1
     if n <= 1:
-        gates = topk_gates(x, router_w, top_k)
         out = _expert_mix(x, gates, w_in, b_in, w_out, b_out, dtype)
         return out.astype(x.dtype)
     e = w_in.shape[0]
     if e % n:
         raise ValueError(f"num experts {e} not divisible by axis size {n}")
 
-    def body(x, router_w, w_in, b_in, w_out, b_out):
+    def body(x, gates, w_in, b_in, w_out, b_out):
         idx = jax.lax.axis_index(axis)
         e_local = w_in.shape[0]
-        gates = topk_gates(x, router_w, top_k)            # full (B, T, E)
         g_local = jax.lax.dynamic_slice_in_dim(
             gates, idx * e_local, e_local, axis=2)
         part = _expert_mix(x, g_local, w_in, b_in, w_out, b_out, dtype)
@@ -108,8 +131,8 @@ def moe_mlp(
     x_spec = P(batch_axis, None, None) if batch_axis else P(None, None, None)
     f = shard_map_unchecked(
         body, mesh=mesh,
-        in_specs=(x_spec, P(None, None), P(axis, None, None), P(axis, None),
+        in_specs=(x_spec, x_spec, P(axis, None, None), P(axis, None),
                   P(axis, None, None), P(axis, None)),
         out_specs=x_spec,
     )
-    return f(x, router_w, w_in, b_in, w_out, b_out).astype(x.dtype)
+    return f(x, gates, w_in, b_in, w_out, b_out).astype(x.dtype)

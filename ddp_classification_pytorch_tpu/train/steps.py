@@ -87,7 +87,10 @@ def make_train_step(
     def loss_fn(params, batch_stats, images, labels, rng):
         variables = {"params": params, "batch_stats": batch_stats}
         mask_rng, drop_rng = jax.random.split(rng)
-        kwargs = dict(train=True, mutable=["batch_stats"], rngs={"dropout": drop_rng})
+        # 'losses' collects sown auxiliary penalties (MoE router balance);
+        # models without them just leave it empty
+        kwargs = dict(train=True, mutable=["batch_stats", "losses"],
+                      rngs={"dropout": drop_rng})
         if workload == "arcface":
             logits, mutated = model.apply(variables, images, labels, **kwargs)
         elif workload == "nested":
@@ -97,6 +100,9 @@ def make_train_step(
         else:
             logits, mutated = model.apply(variables, images, **kwargs)
         loss = _cross_entropy(logits, labels)
+        aux = sum(jax.tree_util.tree_leaves(mutated.get("losses", {})))
+        if cfg.model.moe_aux_weight:
+            loss = loss + cfg.model.moe_aux_weight * aux
         return loss, (mutated.get("batch_stats", batch_stats), logits)
 
     return _build_step(tx, base_rng, loss_fn,
@@ -156,12 +162,18 @@ def _arcface_sharded_loss(cfg, model, mesh):
         variables = {"params": params, "batch_stats": batch_stats}
         _, drop_rng = jax.random.split(rng)  # same derivation as dense path
         emb, mutated = model.apply(
-            variables, images, train=True, mutable=["batch_stats"],
+            variables, images, train=True,
+            mutable=["batch_stats", "losses"],
             rngs={"dropout": drop_rng}, method="features")
         loss, t1, t3 = arc_margin_ce_sharded(
             emb, params["margin"]["weight"], labels, mesh, MODEL_AXIS,
             batch_axis=batch_axis, s=mc.arc_s, m=mc.arc_m,
             easy_margin=mc.arc_easy_margin)
+        # sown auxiliary penalties (MoE router balance on a ViT backbone)
+        # flow into this path too — same contract as the dense step
+        aux = sum(jax.tree_util.tree_leaves(mutated.get("losses", {})))
+        if cfg.model.moe_aux_weight:
+            loss = loss + cfg.model.moe_aux_weight * aux
         return loss, (mutated.get("batch_stats", batch_stats), (t1, t3))
 
     def metrics_fn(loss, aux, labels):

@@ -11,7 +11,11 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 
-from ddp_classification_pytorch_tpu.ops.moe import moe_mlp, topk_gates
+from ddp_classification_pytorch_tpu.ops.moe import (
+    moe_mlp,
+    router_logits,
+    topk_gates,
+)
 from ddp_classification_pytorch_tpu.parallel import mesh as meshlib
 
 
@@ -25,7 +29,7 @@ def _params(c=16, e=4, h=16, seed=0):
 def test_topk_gates_sparse_and_normalized():
     p = _params()
     x = jnp.asarray(np.random.default_rng(1).normal(size=(2, 8, 16)), jnp.float32)
-    g = topk_gates(x, p["router_w"], top_k=2)
+    g = topk_gates(router_logits(x, p["router_w"]), top_k=2)
     nz = np.count_nonzero(np.asarray(g), axis=-1)
     assert (nz == 2).all()
     np.testing.assert_allclose(np.asarray(g.sum(-1)), 1.0, atol=1e-6)
@@ -36,10 +40,12 @@ def test_moe_sharded_matches_unsharded(mp):
     mesh = meshlib.make_mesh(meshlib.MeshSpec(len(jax.devices()) // mp, mp))
     p = _params()
     x = jnp.asarray(np.random.default_rng(2).normal(size=(8, 8, 16)), jnp.float32)
-    dense = moe_mlp(x, **p, top_k=2, dtype=jnp.float32)
-    sharded = jax.jit(lambda x: moe_mlp(
-        x, **p, top_k=2, dtype=jnp.float32, mesh=mesh,
-        axis=meshlib.MODEL_AXIS, batch_axis=meshlib.DATA_AXIS))(x)
+    gates = topk_gates(router_logits(x, p["router_w"]), top_k=2)
+    ew = {k: v for k, v in p.items() if k != "router_w"}
+    dense = moe_mlp(x, gates, **ew, dtype=jnp.float32)
+    sharded = jax.jit(lambda x, g: moe_mlp(
+        x, g, **ew, dtype=jnp.float32, mesh=mesh,
+        axis=meshlib.MODEL_AXIS, batch_axis=meshlib.DATA_AXIS))(x, gates)
     np.testing.assert_allclose(np.asarray(sharded), np.asarray(dense), atol=1e-5)
 
 
@@ -51,8 +57,13 @@ def test_moe_sharded_gradients_match_unsharded():
     def loss(kind):
         kw = (dict(mesh=mesh, axis=meshlib.MODEL_AXIS,
                    batch_axis=meshlib.DATA_AXIS) if kind == "sharded" else {})
-        return lambda x, p: (moe_mlp(x, **p, top_k=2, dtype=jnp.float32,
-                                     **kw) ** 2).mean()
+
+        def f(x, p):
+            gates = topk_gates(router_logits(x, p["router_w"]), top_k=2)
+            ew = {k: v for k, v in p.items() if k != "router_w"}
+            return (moe_mlp(x, gates, **ew, dtype=jnp.float32, **kw) ** 2).mean()
+
+        return f
 
     gs = jax.jit(jax.grad(loss("sharded"), argnums=(0, 1)))(x, p)
     gd = jax.grad(loss("dense"), argnums=(0, 1))(x, p)
@@ -64,8 +75,10 @@ def test_moe_rejects_indivisible_experts():
     mesh = meshlib.make_mesh(meshlib.MeshSpec(2, 4))
     p = _params(e=6, h=8)
     x = jnp.zeros((4, 4, 16), jnp.float32)
+    gates = topk_gates(router_logits(x, p["router_w"]), top_k=2)
+    ew = {k: v for k, v in p.items() if k != "router_w"}
     with pytest.raises(ValueError, match="not divisible"):
-        moe_mlp(x, **p, mesh=mesh, axis=meshlib.MODEL_AXIS)
+        moe_mlp(x, gates, **ew, mesh=mesh, axis=meshlib.MODEL_AXIS)
 
 
 def test_vit_moe_trains_on_expert_parallel_mesh():
@@ -115,7 +128,7 @@ def test_moe_invalid_configs_fail_loudly():
     p = _params(e=2, h=32)
     x = jnp.zeros((2, 4, 16), jnp.float32)
     with pytest.raises(ValueError, match="top_k"):
-        moe_mlp(x, **p, top_k=3, dtype=jnp.float32)
+        topk_gates(router_logits(x, p["router_w"]), top_k=3)
 
     cfg = get_preset("baseline").model
     cfg.arch = "vit_t16"
@@ -129,3 +142,56 @@ def test_moe_invalid_configs_fail_loudly():
     mesh = meshlib.make_mesh(meshlib.MeshSpec(4, 2))
     with pytest.raises(ValueError, match="one role per config"):
         build_model(cfg, 8, mesh=mesh, pipeline_microbatches=2)
+
+
+def test_load_balance_loss_penalizes_collapse():
+    """A router collapsed onto one expert must score higher than a
+    near-uniform one; the uniform limit is ≈ top_k (Switch convention)."""
+    from ddp_classification_pytorch_tpu.ops.moe import load_balance_loss
+
+    rng = np.random.default_rng(0)
+    # feature 0 strictly positive so a router keyed on it collapses every
+    # token onto expert 0 (the router is linear in x — no bias term)
+    x = jnp.asarray(np.abs(rng.normal(size=(2, 16, 8))) + 0.1, jnp.float32)
+    uniform = jnp.zeros((8, 4), jnp.float32)      # logits all equal
+    collapsed = jnp.zeros((8, 4), jnp.float32).at[0, 0].set(50.0)
+    lu = float(load_balance_loss(router_logits(x, uniform), top_k=2))
+    lc = float(load_balance_loss(router_logits(x, collapsed), top_k=2))
+    assert lc > lu
+    assert lc == pytest.approx(4.0, abs=0.1)      # E·f_0·p_0 = 4·1·1
+    assert lu == pytest.approx(2.0, abs=0.3)      # ≈ top_k when uniform
+
+
+def test_moe_aux_loss_enters_training_loss():
+    """The sown per-block penalties must reach the train loss: weight 0 vs
+    default weight give different losses from identical state; and the
+    remat path must tolerate the 'losses' collection."""
+    from ddp_classification_pytorch_tpu.config import get_preset
+    from ddp_classification_pytorch_tpu.train.state import create_train_state
+    from ddp_classification_pytorch_tpu.train.steps import make_train_step
+
+    mesh = meshlib.make_mesh(meshlib.MeshSpec(len(jax.devices()), 1))
+    rng = np.random.default_rng(0)
+    images = rng.normal(size=(8, 32, 32, 3)).astype(np.float32)
+    labels = rng.integers(0, 8, 8).astype(np.int32)
+    losses = {}
+    for w in (0.0, 0.01):
+        cfg = get_preset("baseline")
+        cfg.model.arch = "vit_t16"
+        cfg.model.dtype = "float32"
+        cfg.model.moe_experts = 4
+        cfg.model.moe_aux_weight = w
+        cfg.model.remat = True
+        cfg.data.image_size = 32
+        cfg.data.num_classes = 8
+        cfg.data.batch_size = 8
+        with mesh:
+            model, tx, state = create_train_state(cfg, mesh, steps_per_epoch=4)
+            step = make_train_step(cfg, model, tx, mesh=mesh)
+            x = jax.device_put(images, meshlib.batch_sharding(mesh))
+            y = jax.device_put(labels, meshlib.batch_sharding(mesh))
+            _, metrics = step(state, x, y)
+            losses[w] = float(metrics["loss"])
+    assert losses[0.01] > losses[0.0]
+    # aux ≈ top_k per block × 12 blocks × 0.01 weight ≈ 0.24 at init
+    assert losses[0.01] - losses[0.0] == pytest.approx(0.24, abs=0.1)
