@@ -149,9 +149,9 @@ def build_parser() -> argparse.ArgumentParser:
                           "shard over the model axis (expert parallelism)")
     par.add_argument("--moe_top_k", type=int, default=2,
                      help="router top-k for --moe_experts")
-    par.add_argument("--moe_aux_weight", type=float, default=-1.0,
+    par.add_argument("--moe_aux_weight", type=float, default=None,
                      help="router load-balance penalty weight "
-                          "(default 0.01)")
+                          "(default 0.01; 0 disables)")
     par.add_argument("--sharded_ce", action="store_true",
                      help="arcface: partial-FC loss — class-sharded "
                           "softmax-CE over the model axis, no (B, C) "
@@ -296,7 +296,10 @@ def config_from_args(args: argparse.Namespace) -> Config:
     if args.moe_experts:
         cfg.model.moe_experts = args.moe_experts
         cfg.model.moe_top_k = args.moe_top_k
-        if args.moe_aux_weight >= 0:
+        if args.moe_aux_weight is not None:
+            if args.moe_aux_weight < 0:
+                raise SystemExit(
+                    f"--moe_aux_weight must be >= 0, got {args.moe_aux_weight}")
             cfg.model.moe_aux_weight = args.moe_aux_weight
     return cfg
 

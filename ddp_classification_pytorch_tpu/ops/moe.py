@@ -112,11 +112,16 @@ def moe_mlp(
     paths are numerically identical (test-pinned): distribution decides
     where experts live, never the math.
     """
+    e = w_in.shape[0]
+    if gates.shape[-1] != e:
+        # the sharded path's dynamic_slice would clamp a wrong width into
+        # silently wrong output — reject it here for both paths
+        raise ValueError(
+            f"gates width {gates.shape[-1]} != num experts {e}")
     n = mesh.shape[axis] if (mesh is not None and axis) else 1
     if n <= 1:
         out = _expert_mix(x, gates, w_in, b_in, w_out, b_out, dtype)
         return out.astype(x.dtype)
-    e = w_in.shape[0]
     if e % n:
         raise ValueError(f"num experts {e} not divisible by axis size {n}")
 

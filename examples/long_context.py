@@ -1,12 +1,13 @@
 """Long-context / multi-axis parallelism demo on a virtual 8-device mesh.
 
-Runs four flavors of the SAME ViT training step — pure DP, DP × ring-
-attention sequence parallelism (blockwise and flash-kernel variants), and
-DP × GPipe pipeline parallelism. The DP and both SP rows print IDENTICAL
-losses (same flax params, and ring attention is exact in either variant);
-the PP row uses the pipelined model's own initializer, so its trajectory
-differs while test_pipeline.py pins its math to the sequential reference.
-No TPU needed:
+Runs five flavors of the SAME ViT training step — pure DP, DP × ring-
+attention sequence parallelism (blockwise and flash-kernel variants),
+DP × GPipe pipeline parallelism, and DP × expert-parallel MoE. The DP and
+both SP rows print IDENTICAL losses (same flax params, and ring attention
+is exact in either variant); the PP and EP rows use different models
+(pipelined initializer / mixture FFN), so their trajectories differ while
+test_pipeline.py and test_moe.py pin their math to references. No TPU
+needed:
 
     python examples/long_context.py
 
@@ -34,7 +35,7 @@ from ddp_classification_pytorch_tpu.train.state import create_train_state
 from ddp_classification_pytorch_tpu.train.steps import make_train_step
 
 
-def run(name, dp, mp, pp_microbatches=0, steps=3, flash=False):
+def run(name, dp, mp, pp_microbatches=0, steps=3, flash=False, moe=0):
     cfg = get_preset("baseline")
     cfg.model.arch = "vit_t16"
     cfg.model.dtype = "float32"
@@ -44,6 +45,7 @@ def run(name, dp, mp, pp_microbatches=0, steps=3, flash=False):
     cfg.parallel.model_axis = mp
     cfg.parallel.pipeline_microbatches = pp_microbatches
     cfg.model.flash_attention = flash
+    cfg.model.moe_experts = moe
 
     mesh = meshlib.make_mesh(meshlib.MeshSpec(dp, mp))
     rng = np.random.default_rng(0)
@@ -67,3 +69,4 @@ if __name__ == "__main__":
     run("DP × SP (ring attention)", 4, 2)
     run("DP × SP (flash ring)", 4, 2, flash=True)
     run("DP × PP (GPipe, M=4)", 4, 2, pp_microbatches=4)
+    run("DP × EP (MoE, E=4)", 4, 2, moe=4)
