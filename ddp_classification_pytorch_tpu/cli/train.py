@@ -293,13 +293,13 @@ def config_from_args(args: argparse.Namespace) -> Config:
         cfg.parallel.dcn_slices = args.dcn_slices
     if args.sharded_ce:
         cfg.parallel.arcface_sharded_ce = True
+    if args.moe_aux_weight is not None and args.moe_aux_weight < 0:
+        raise SystemExit(
+            f"--moe_aux_weight must be >= 0, got {args.moe_aux_weight}")
     if args.moe_experts:
         cfg.model.moe_experts = args.moe_experts
         cfg.model.moe_top_k = args.moe_top_k
         if args.moe_aux_weight is not None:
-            if args.moe_aux_weight < 0:
-                raise SystemExit(
-                    f"--moe_aux_weight must be >= 0, got {args.moe_aux_weight}")
             cfg.model.moe_aux_weight = args.moe_aux_weight
     return cfg
 
