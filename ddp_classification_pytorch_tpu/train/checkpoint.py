@@ -40,6 +40,59 @@ def _place_like(template: Any, restored: Any) -> Any:
     )
 
 
+def _replicated_gather(mesh):
+    """Cached jitted identity that all-gathers its inputs to full
+    replication on `mesh` — cached so per-epoch saves reuse one compiled
+    program instead of retracing a fresh lambda every call."""
+    if mesh not in _replicated_gather._cache:
+        from jax.sharding import NamedSharding, PartitionSpec
+
+        _replicated_gather._cache[mesh] = jax.jit(
+            lambda xs: xs,
+            out_shardings=NamedSharding(mesh, PartitionSpec()))
+    return _replicated_gather._cache[mesh]
+
+
+_replicated_gather._cache = {}
+
+
+def _to_host(state: Any) -> Any:
+    """Full host copy of a (possibly multi-host-sharded) pytree.
+
+    Under multi-host tensor parallelism, some shards of a TP-sharded leaf
+    (e.g. the ArcFace margin weight) live ONLY on other processes, so a
+    plain `jax.device_get` raises on non-addressable data. Exactly those
+    leaves — sharded AND not fully replicated — are all-gathered by one
+    cached jitted identity (every process must call this — it is a
+    collective); fully-replicated and single-host leaves take the
+    zero-communication device_get path, so plain multi-host DDP (no TP)
+    never pays a gather and the replication memory spike is bounded to
+    the genuinely sharded leaves."""
+    leaves, treedef = jax.tree_util.tree_flatten(state)
+    idx = [i for i, l in enumerate(leaves)
+           if isinstance(l, jax.Array) and not l.is_fully_addressable
+           and not l.is_fully_replicated]
+    if idx:
+        mesh = leaves[idx[0]].sharding.mesh
+        gathered = _replicated_gather(mesh)(tuple(leaves[i] for i in idx))
+        for i, g in zip(idx, gathered):
+            leaves[i] = g
+    return jax.device_get(jax.tree_util.tree_unflatten(treedef, leaves))
+
+
+def _host_skeleton(template: Any) -> Any:
+    """Zero-filled numpy pytree matching `template`'s shapes/dtypes — a
+    from_bytes target that costs no device transfer and (crucially) no
+    collective, so restore never requires hosts to enter it in lockstep."""
+    import numpy as np
+
+    return jax.tree_util.tree_map(
+        lambda l: (np.zeros(l.shape, l.dtype)
+                   if isinstance(l, jax.Array) else l),
+        template,
+    )
+
+
 class CheckpointManager:
     def __init__(
         self,
@@ -81,12 +134,17 @@ class CheckpointManager:
         self._write_many(state, [path])
 
     def _write_many(self, state: Any, paths, prune_after: bool = False,
-                    meta_updates: Optional[dict] = None) -> None:
-        """One device_get + one serialization, written to every path (a
+                    meta_updates: Optional[dict] = None,
+                    host_state: Optional[Any] = None) -> None:
+        """One host transfer + one serialization, written to every path (a
         new-best epoch writes the same bytes to ckpt_eN and ckpt_best).
         `meta_updates` land AFTER the checkpoint bytes — meta must never
-        point at a checkpoint that has not hit disk yet."""
-        host_state = jax.device_get(state)
+        point at a checkpoint that has not hit disk yet. Callers on a
+        multi-host deployment pass `host_state` (gathered collectively on
+        every process by `_to_host`) since this method runs on host 0
+        only."""
+        if host_state is None:
+            host_state = _to_host(state)
 
         def serialize_and_write():
             data = serialization.to_bytes(host_state)
@@ -161,13 +219,17 @@ class CheckpointManager:
         is_best = metric is not None and metric > self.best_metric
         if metric is not None:
             self.best_metric = max(self.best_metric, metric)
-        if not is_host0():
-            return is_best
         paths = []
         if self.save_every_epoch and not self.best_only:
             paths.append(self.epoch_path(epoch))
         if is_best:
             paths.append(self.best_path)
+        # The host transfer may be a cross-process all-gather (TP-sharded
+        # leaves), so EVERY host runs it — `paths` is identical on all
+        # hosts (flags + replicated metric) — and only host 0 writes.
+        host_state = _to_host(state) if paths else None
+        if not is_host0():
+            return is_best
         meta_updates: dict = {"last_epoch": epoch}
         if is_best:
             meta_updates.update(
@@ -179,7 +241,8 @@ class CheckpointManager:
         if paths:
             # meta rides with the write so it lands strictly after the bytes
             self._write_many(state, paths, prune_after=True,
-                             meta_updates=meta_updates)
+                             meta_updates=meta_updates,
+                             host_state=host_state)
         else:
             self._write_meta(**meta_updates)
         return is_best
@@ -201,8 +264,14 @@ class CheckpointManager:
 
     # -------------------------------------------------------------- restore --
     def restore(self, template_state: Any, path: str) -> Any:
+        """Collective-free: the from_bytes target is a numpy skeleton, so a
+        single host can restore without the others. On multi-host runs
+        `out_dir` must be visible to every host (shared filesystem or
+        per-host copies) — hosts that miss the file would silently keep
+        the template values."""
         with open(path, "rb") as f:
-            restored = serialization.from_bytes(jax.device_get(template_state), f.read())
+            restored = serialization.from_bytes(
+                _host_skeleton(template_state), f.read())
         return _place_like(template_state, restored)
 
     def restore_latest(self, template_state: Any) -> Tuple[Any, int]:
