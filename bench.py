@@ -37,13 +37,10 @@ def main() -> None:
     ap.add_argument("--warmup", type=int, default=10)
     args = ap.parse_args()
 
-    import os
-
-    if os.environ.get("JAX_PLATFORMS"):
-        # honor an explicit platform override even under a sitecustomize
-        # that pins the TPU plugin (env alone doesn't switch it)
-        jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
-
+    from ddp_classification_pytorch_tpu.utils.backend_probe import (
+        backend_watchdog,
+        require_backend,
+    )
     from ddp_classification_pytorch_tpu.utils.cache import enable_persistent_cache
 
     enable_persistent_cache()  # the driver re-benches every round
@@ -53,62 +50,24 @@ def main() -> None:
     from ddp_classification_pytorch_tpu.train.state import create_train_state
     from ddp_classification_pytorch_tpu.train.steps import make_train_step
 
-    # The tunneled TPU backend can be transiently UNAVAILABLE (lease churn).
-    # Two failure shapes, observed live: (a) jax.devices() RAISES — handled
-    # by the retry loop below; (b) jax.devices() BLOCKS indefinitely inside
-    # the plugin's lease-poll sleep — no exception ever surfaces, so probe
-    # the backend in a killable SUBPROCESS first and only touch jax here
-    # once a probe has returned. Killing the probe is safe: it never gets
-    # far enough to compile.
-    import subprocess
-
-    probe_src = (
-        # honor an explicit platform override even under a sitecustomize
-        # that pins the TPU plugin (env alone doesn't switch it)
-        "import os, jax\n"
-        "p = os.environ.get('JAX_PLATFORMS')\n"
-        "if p: jax.config.update('jax_platforms', p)\n"
-        "jax.devices()\n"
-    )
-    probe_attempts = 8
-    for attempt in range(probe_attempts):
-        try:
-            subprocess.run([sys.executable, "-c", probe_src],
-                           timeout=150, check=True, capture_output=True)
-            break
-        except (subprocess.TimeoutExpired, subprocess.CalledProcessError) as e:
-            err = (e.stderr or b"")[-300:].decode(errors="replace").strip()
-            print(f"# backend probe failed (attempt {attempt + 1}/"
-                  f"{probe_attempts}): {type(e).__name__}"
-                  + (f": {err}" if err else ""), file=sys.stderr)
-            if attempt == probe_attempts - 1:
-                # a blocked backend would hang the in-process attempt
-                # FOREVER (no exception surfaces from the lease poll) —
-                # exit loudly instead so the caller records the outage
-                print("# backend unreachable after all probes; aborting",
-                      file=sys.stderr)
-                sys.exit(3)
-            time.sleep(min(30 * (attempt + 1), 120))
-    # The probe can pass and the lease churn seconds later; the in-process
-    # jax.devices() would then block forever with no exception. A watchdog
-    # thread turns that into a loud bounded failure.
-    import threading
-
-    backend_up = threading.Event()
-
-    def _watchdog():
-        if not backend_up.wait(900):
-            print("# backend hung after successful probe; aborting",
-                  file=sys.stderr)
-            os._exit(4)
-
-    threading.Thread(target=_watchdog, daemon=True).start()
+    # The tunneled TPU backend can be transiently UNAVAILABLE (lease churn)
+    # or HUNG (jax.devices() blocks forever in the lease poll — observed
+    # live). Probe in a killable subprocess first (utils/backend_probe.py),
+    # exiting loudly so the caller records the outage; a watchdog bounds
+    # the in-process init in case the lease churns right after a
+    # successful probe.
+    try:
+        require_backend()
+    except RuntimeError as e:
+        print(f"# {e}", file=sys.stderr)
+        sys.exit(3)
+    backend_up = backend_watchdog(900)
 
     attempts = 5
     for attempt in range(attempts):
         try:
             devices = jax.devices()
-            backend_up.set()
+            backend_up()
             break
         except RuntimeError as e:
             if attempt == attempts - 1:

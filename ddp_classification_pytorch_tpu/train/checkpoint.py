@@ -130,9 +130,6 @@ class CheckpointManager:
         return os.path.join(self.out_dir, "meta.json")
 
     # ----------------------------------------------------------------- save --
-    def _write(self, state: Any, path: str) -> None:
-        self._write_many(state, [path])
-
     def _write_many(self, state: Any, paths, prune_after: bool = False,
                     meta_updates: Optional[dict] = None,
                     host_state: Optional[Any] = None) -> None:
@@ -144,6 +141,12 @@ class CheckpointManager:
         every process by `_to_host`) since this method runs on host 0
         only."""
         if host_state is None:
+            # _to_host may be a cross-process collective, which this
+            # host-0-only method must never trigger — a caller forgetting
+            # host_state on a multi-host run would deadlock here
+            assert jax.process_count() == 1, (
+                "multi-host callers must pass host_state gathered on every "
+                "process (see save())")
             host_state = _to_host(state)
 
         def serialize_and_write():

@@ -1,0 +1,99 @@
+"""Killable-subprocess probe + watchdog for a hung JAX backend.
+
+Observed live on the tunneled TPU plugin: `jax.devices()` can BLOCK
+indefinitely inside the plugin's lease poll — no exception ever surfaces,
+so in-process retry loops never fire and the caller hangs forever. Two
+failure shapes, two tools:
+
+- `require_backend()` probes the backend in a SUBPROCESS (killable on
+  timeout) with retries/backoff before the caller touches jax, raising a
+  diagnostic RuntimeError when the backend never answers;
+- `backend_watchdog()` bounds the caller's own first backend init, for the
+  window where a probe passes and the lease churns seconds later (the hung
+  thread cannot be cancelled, so the watchdog exits the process loudly).
+
+Both honor an explicit JAX_PLATFORMS override even under a sitecustomize
+that pins the TPU plugin (env alone does not switch the platform — the
+config must be updated before first backend use).
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+import threading
+import time
+from typing import Callable
+
+_PROBE_SRC = (
+    "import os, jax\n"
+    "p = os.environ.get('JAX_PLATFORMS')\n"
+    "if p: jax.config.update('jax_platforms', p)\n"
+    "jax.devices()\n"
+)
+
+
+def pin_platform_from_env() -> None:
+    """Apply JAX_PLATFORMS to this process's jax config (no-op when unset
+    or when a backend is already initialized)."""
+    p = os.environ.get("JAX_PLATFORMS")
+    if not p:
+        return
+    import jax
+
+    try:
+        jax.config.update("jax_platforms", p)
+    except Exception as e:
+        # backend already initialized on another platform: the probe
+        # subprocess would then validate a DIFFERENT platform than this
+        # process runs — say so instead of misdiagnosing later
+        print(f"# JAX_PLATFORMS={p} could not be applied in-process "
+              f"({e}); probe and run may target different platforms",
+              file=sys.stderr)
+
+
+def require_backend(attempts: int = 8, probe_timeout: int = 150,
+                    backoff_cap: int = 120) -> None:
+    """Probe the backend in a killable subprocess until it answers.
+
+    Raises RuntimeError (with the last probe's stderr tail) if it never
+    does — callers turn that into their own exit path instead of hanging.
+    Also pins JAX_PLATFORMS into the CALLING process so the code being
+    protected runs on the same platform the probe checked.
+    """
+    pin_platform_from_env()
+    last = ""
+    for attempt in range(attempts):
+        try:
+            subprocess.run([sys.executable, "-c", _PROBE_SRC],
+                           timeout=probe_timeout, check=True,
+                           capture_output=True)
+            return
+        except (subprocess.TimeoutExpired, subprocess.CalledProcessError) as e:
+            err = (e.stderr or b"")[-300:].decode(errors="replace").strip()
+            last = type(e).__name__ + (f": {err}" if err else "")
+            print(f"# backend probe failed (attempt {attempt + 1}/"
+                  f"{attempts}): {last}", file=sys.stderr)
+            if attempt < attempts - 1:
+                time.sleep(min(30 * (attempt + 1), backoff_cap))
+    raise RuntimeError(
+        f"JAX backend unreachable after {attempts} probes ({last}) — "
+        "refusing to hang the caller")
+
+
+def backend_watchdog(seconds: int = 900) -> Callable[[], None]:
+    """Bound the caller's first backend init: returns a `done` callback to
+    invoke once jax calls are answering; if it isn't invoked within
+    `seconds`, the process exits loudly (os._exit — a thread stuck inside
+    the plugin's lease poll cannot be cancelled)."""
+    done = threading.Event()
+
+    def watch():
+        if not done.wait(seconds):
+            print("# backend hung after successful probe; aborting",
+                  file=sys.stderr)
+            os._exit(4)
+
+    threading.Thread(target=watch, daemon=True).start()
+    return done.set
