@@ -1,10 +1,11 @@
-"""Benchmark harness — flagship training-step throughput.
+"""Benchmark harness — training-step throughput for the flagship and the
+parallelism-pentad representatives.
 
-Measures the jitted ResNet-50 train step (bf16 compute, NHWC, global-batch
-sharded over all available devices) on synthetic device-resident data, and
-prints ONE JSON line:
+Prints ONE JSON line (flagship ResNet-50 keys at top level, extra rows under
+"extra"):
 
-    {"metric": ..., "value": N, "unit": "images/sec/chip", "vs_baseline": N}
+    {"metric": ..., "value": N, "unit": "images/sec/chip", "vs_baseline": N,
+     "step_ms": N, "mfu": N, "extra": [{...}, ...]}
 
 The reference publishes no numbers (BASELINE.md); `vs_baseline` is therefore
 computed against a documented stand-in: 2500 images/sec/chip, the
@@ -12,7 +13,19 @@ commonly-cited MLPerf-era ResNet-50 mixed-precision training throughput of a
 single A100 — the hardware class of the reference's own runs
 (BASELINE/train.sh uses 2 local GPUs). vs_baseline = value / 2500.
 
+`mfu` is model-FLOPs utilization: XLA's own cost analysis of the compiled
+train step (flops per execution) divided by (step time × per-chip peak bf16
+FLOP/s for the detected TPU generation). It makes round-over-round perf
+regressions visible in absolute terms, not just relative to the A100 stand-in.
+
+Deadline discipline (the round-1 failure mode was rc=124 — probes consumed
+the driver's whole window): the backend probe budget is capped at ~4.5 min
+(2 × 120 s + one 30 s backoff), the run tracks a global deadline
+(--deadline, default 900 s), extra rows only start while enough budget
+remains, and an unreachable backend exits 3 loudly instead of hanging.
+
 Usage: python bench.py [--batch N] [--steps N] [--arch resnet50]
+                       [--deadline SECONDS] [--rows arcface,vit]
 """
 
 from __future__ import annotations
@@ -22,84 +35,60 @@ import json
 import sys
 import time
 
-import jax
-import numpy as np
-
 A100_RESNET50_IMG_PER_SEC = 2500.0
 
+# Per-chip dense bf16 peak FLOP/s by device_kind substring (public specs).
+# Matched longest-prefix-first so "TPU v5 lite" does not hit "TPU v5".
+_PEAK_BF16 = (
+    ("TPU v6 lite", 918e12),  # Trillium / v6e
+    ("TPU v5 lite", 197e12),  # v5e
+    ("TPU v5p", 459e12),
+    ("TPU v4", 275e12),
+    ("TPU v3", 123e12),
+    ("TPU v2", 46e12),
+)
 
-def main() -> None:
-    ap = argparse.ArgumentParser()
-    ap.add_argument("--arch", default="resnet50")
-    ap.add_argument("--batch", type=int, default=0, help="global batch; 0 = auto")
-    ap.add_argument("--image-size", type=int, default=224)
-    ap.add_argument("--steps", type=int, default=50)
-    ap.add_argument("--warmup", type=int, default=10)
-    args = ap.parse_args()
 
-    from ddp_classification_pytorch_tpu.utils.backend_probe import (
-        backend_watchdog,
-        require_backend,
-    )
-    from ddp_classification_pytorch_tpu.utils.cache import enable_persistent_cache
+def _peak_flops(device_kind: str) -> float | None:
+    for prefix, peak in _PEAK_BF16:
+        if device_kind.startswith(prefix):
+            return peak
+    return None
 
-    enable_persistent_cache()  # the driver re-benches every round
 
-    from ddp_classification_pytorch_tpu.config import get_preset
+def _flops_of(compiled) -> float | None:
+    """PER-DEVICE FLOPs per execution from XLA's cost analysis (the analysis
+    runs on the SPMD-partitioned module, so sharded-out work is already
+    divided out); None when the backend does not report it."""
+    try:
+        ca = compiled.cost_analysis()
+        if isinstance(ca, (list, tuple)):
+            ca = ca[0]
+        f = float(ca.get("flops", 0.0))
+        return f if f > 0 else None
+    except Exception:
+        return None
+
+
+def _bench_row(cfg, mesh, *, steps: int, warmup: int, metric: str,
+               n_chips: int, peak: float | None, seed: int = 0):
+    """Compile (AOT, so cost analysis and execution share one compile),
+    run warmup + timed steps on synthetic device-resident data, and return
+    a row dict with images/sec/chip, step_ms and mfu."""
+    import jax
+    import numpy as np
+
     from ddp_classification_pytorch_tpu.parallel import mesh as meshlib
     from ddp_classification_pytorch_tpu.train.state import create_train_state
     from ddp_classification_pytorch_tpu.train.steps import make_train_step
 
-    # The tunneled TPU backend can be transiently UNAVAILABLE (lease churn)
-    # or HUNG (jax.devices() blocks forever in the lease poll — observed
-    # live). Probe in a killable subprocess first (utils/backend_probe.py),
-    # exiting loudly so the caller records the outage; a watchdog bounds
-    # the in-process init in case the lease churns right after a
-    # successful probe.
-    try:
-        require_backend()
-    except RuntimeError as e:
-        print(f"# {e}", file=sys.stderr)
-        sys.exit(3)
-    backend_up = backend_watchdog(900)
-
-    attempts = 5
-    for attempt in range(attempts):
-        try:
-            devices = jax.devices()
-            backend_up()
-            break
-        except RuntimeError as e:
-            if attempt == attempts - 1:
-                raise
-            print(f"# backend init failed (attempt {attempt + 1}/{attempts}): {e}",
-                  file=sys.stderr)
-            try:
-                jax.extend.backend.clear_backends()
-            except Exception:
-                pass
-            time.sleep(30 * (attempt + 1))
-    n_chips = len(devices)
-    platform = devices[0].platform
-    on_accel = platform in ("tpu", "gpu")
-
-    cfg = get_preset("baseline")
-    cfg.model.arch = args.arch
-    cfg.model.dtype = "bfloat16" if on_accel else "float32"
-    cfg.data.num_classes = 1000
-    cfg.data.image_size = args.image_size if on_accel else 64
-    batch = args.batch or (256 * n_chips if on_accel else 8 * n_chips)
-    cfg.data.batch_size = batch
-    steps = args.steps if on_accel else 3
-    warmup = args.warmup if on_accel else 1
-
-    mesh = meshlib.make_mesh(devices=devices)
     with mesh:
         model, tx, state = create_train_state(cfg, mesh, steps_per_epoch=100)
-        step = make_train_step(cfg, model, tx)
+        step = make_train_step(cfg, model, tx, mesh=mesh)
 
-        rng = np.random.default_rng(0)
+        rng = np.random.default_rng(seed)
         h = cfg.data.image_size
+        batch = cfg.data.batch_size
         images = jax.device_put(
             rng.normal(size=(batch, h, h, 3)).astype(np.float32),
             meshlib.batch_sharding(mesh),
@@ -109,33 +98,189 @@ def main() -> None:
             meshlib.batch_sharding(mesh),
         )
 
+        compiled = step.lower(state, images, labels).compile()
+        flops = _flops_of(compiled)
+
         for _ in range(warmup):
-            state, metrics = step(state, images, labels)
-        float(metrics["loss"])  # device_get: hard sync (block_until_ready does
-        # not reliably wait for remote/tunneled TPU execution)
+            state, metrics = compiled(state, images, labels)
+        if warmup:
+            float(metrics["loss"])  # device_get: hard sync (block_until_ready
+            # does not reliably wait for remote/tunneled TPU execution)
 
         t0 = time.perf_counter()
         for _ in range(steps):
-            state, metrics = step(state, images, labels)
+            state, metrics = compiled(state, images, labels)
         float(metrics["loss"])  # hard sync closes the timing window
         dt = time.perf_counter() - t0
 
-    img_per_sec = batch * steps / dt
-    per_chip = img_per_sec / n_chips
-    print(
-        json.dumps(
-            {
-                "metric": f"{args.arch}_train_images_per_sec_per_chip"
-                + ("" if on_accel else f"_{platform}"),
-                "value": round(per_chip, 2),
-                "unit": "images/sec/chip",
-                "vs_baseline": round(per_chip / A100_RESNET50_IMG_PER_SEC, 4),
-            }
-        )
+    step_s = dt / steps
+    per_chip = batch / step_s / n_chips
+    row = {
+        "metric": metric,
+        "value": round(per_chip, 2),
+        "unit": "images/sec/chip",
+        "step_ms": round(step_s * 1e3, 2),
+    }
+    if flops is not None and peak is not None:
+        # flops is per-device (SPMD-partitioned module) → divide by the
+        # per-chip peak only
+        row["mfu"] = round(flops / step_s / peak, 4)
+    return row
+
+
+def main() -> None:
+    t_start = time.monotonic()
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--arch", default="resnet50")
+    ap.add_argument("--batch", type=int, default=0, help="global batch; 0 = auto")
+    ap.add_argument("--image-size", type=int, default=224)
+    ap.add_argument("--steps", type=int, default=50)
+    ap.add_argument("--warmup", type=int, default=10)
+    ap.add_argument("--deadline", type=float, default=900.0,
+                    help="total wall-clock budget in seconds; 0 = unbounded. "
+                         "Extra rows are skipped when the remaining budget "
+                         "is too thin for another compile.")
+    ap.add_argument("--rows", default="arcface,vit",
+                    help="comma list of extra rows (arcface, vit); '' = none")
+    args = ap.parse_args()
+
+    def remaining() -> float:
+        if not args.deadline:
+            return float("inf")
+        return args.deadline - (time.monotonic() - t_start)
+
+    from ddp_classification_pytorch_tpu.utils.backend_probe import (
+        backend_watchdog,
+        require_backend,
     )
+    from ddp_classification_pytorch_tpu.utils.cache import enable_persistent_cache
+
+    enable_persistent_cache()  # the driver re-benches every round
+
+    import jax
+
+    from ddp_classification_pytorch_tpu.config import get_preset
+    from ddp_classification_pytorch_tpu.parallel import mesh as meshlib
+
+    # The tunneled TPU backend can be transiently UNAVAILABLE (lease churn)
+    # or HUNG (jax.devices() blocks forever in the lease poll — observed
+    # live). Probe in a killable subprocess first (utils/backend_probe.py),
+    # with a HARD CAP of ~4.5 min so an outage burns minutes, not the
+    # driver's whole window; exit 3 loudly on failure. A watchdog bounds the
+    # in-process init in case the lease churns right after a good probe.
+    try:
+        require_backend(attempts=2, probe_timeout=120)
+    except RuntimeError as e:
+        print(f"# {e}", file=sys.stderr)
+        sys.exit(3)
+    backend_up = backend_watchdog(600)
+
+    for attempt in range(2):
+        try:
+            devices = jax.devices()
+            backend_up()
+            break
+        except RuntimeError as e:
+            if attempt == 1:
+                raise
+            print(f"# backend init failed (attempt 1/2): {e}", file=sys.stderr)
+            try:
+                jax.extend.backend.clear_backends()
+            except Exception:
+                pass
+            time.sleep(15)
+    n_chips = len(devices)
+    platform = devices[0].platform
+    on_accel = platform in ("tpu", "gpu")
+    peak = _peak_flops(devices[0].device_kind) if platform == "tpu" else None
+
+    mesh = meshlib.make_mesh(devices=devices)
+
+    cfg = get_preset("baseline")
+    cfg.model.arch = args.arch
+    cfg.model.dtype = "bfloat16" if on_accel else "float32"
+    cfg.data.num_classes = 1000
+    cfg.data.image_size = args.image_size if on_accel else 64
+    cfg.data.batch_size = args.batch or (256 * n_chips if on_accel else 8 * n_chips)
+    steps = max(args.steps, 1) if on_accel else 3
+    warmup = max(args.warmup, 0) if on_accel else 1
+
+    main_row = _bench_row(
+        cfg, mesh, steps=steps, warmup=warmup, n_chips=n_chips, peak=peak,
+        metric=f"{args.arch}_train_images_per_sec_per_chip"
+        + ("" if on_accel else f"_{platform}"),
+    )
+    main_row["vs_baseline"] = round(main_row["value"] / A100_RESNET50_IMG_PER_SEC, 4)
     print(
-        f"# {platform} x{n_chips}, global batch {batch}, image {h}px, "
-        f"{steps} steps in {dt:.2f}s, dtype {cfg.model.dtype}",
+        f"# flagship: {platform} x{n_chips}, batch {cfg.data.batch_size}, "
+        f"{cfg.data.image_size}px, {steps} steps, step {main_row['step_ms']}ms, "
+        f"mfu {main_row.get('mfu', 'n/a')}, {remaining():.0f}s budget left",
+        file=sys.stderr,
+    )
+
+    # Extra rows: one representative per additional parallelism surface the
+    # driver should see regress (VERDICT r1 #8). Each needs its own compile,
+    # so only start a row while a conservative slice of budget remains.
+    extra = []
+    row_budget = 240.0  # compile + measure headroom per row
+    for name in [r for r in args.rows.split(",") if r]:
+        if remaining() < row_budget:
+            print(f"# skipping extra row {name!r}: {remaining():.0f}s left "
+                  f"< {row_budget:.0f}s budget", file=sys.stderr)
+            continue
+        try:
+            if name == "arcface":
+                c = get_preset("arcface")
+                c.model.dtype = cfg.model.dtype
+                c.data.image_size = cfg.data.image_size
+                c.data.batch_size = (128 if on_accel else 8) * n_chips
+                # partial-FC path needs a model axis > 1; on a single chip
+                # the dense margin head is the honest measurement
+                label = "arcface_resnet50"
+                if n_chips >= 2:
+                    c.parallel.model_axis = 2
+                    c.parallel.arcface_sharded_ce = True
+                    # class-sharded head needs C % mp == 0; round the
+                    # reference's 2173 up — perf-neutral, noted in the metric
+                    mp = c.parallel.model_axis
+                    c.data.num_classes = -(-c.data.num_classes // mp) * mp
+                    label += "_sharded_ce"
+                row_mesh = meshlib.make_mesh(
+                    meshlib.MeshSpec(model_parallel=c.parallel.model_axis),
+                    devices=devices)
+            elif name == "vit":
+                c = get_preset("baseline")
+                c.model.arch = "vit_s16"
+                c.model.flash_attention = True
+                c.model.dtype = cfg.model.dtype
+                c.data.num_classes = 1000
+                c.data.image_size = cfg.data.image_size
+                c.data.batch_size = (128 if on_accel else 8) * n_chips
+                label = "vit_s16_flash"
+                row_mesh = mesh
+            else:
+                print(f"# unknown extra row {name!r}", file=sys.stderr)
+                continue
+            row = _bench_row(
+                c, row_mesh, steps=max(steps // 2, 1), warmup=max(warmup // 2, 1),
+                n_chips=n_chips, peak=peak,
+                metric=f"{label}_train_images_per_sec_per_chip"
+                + ("" if on_accel else f"_{platform}"),
+            )
+            extra.append(row)
+            print(f"# extra row {name}: {row['value']} img/s/chip, "
+                  f"step {row['step_ms']}ms, mfu {row.get('mfu', 'n/a')}",
+                  file=sys.stderr)
+        except Exception as e:  # a broken extra row must not cost the flagship line
+            print(f"# extra row {name!r} failed: {type(e).__name__}: {e}",
+                  file=sys.stderr)
+
+    if extra:
+        main_row["extra"] = extra
+    print(json.dumps(main_row), flush=True)
+    print(
+        f"# {platform} x{n_chips} ({devices[0].device_kind}), dtype "
+        f"{cfg.model.dtype}, {time.monotonic() - t_start:.0f}s total",
         file=sys.stderr,
     )
 

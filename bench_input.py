@@ -1,0 +1,140 @@
+"""Host input-pipeline benchmark — can the loader feed the chip?
+
+The chip-side bench (bench.py) deliberately excludes input; this harness
+measures the host side: JPEG decode + train-transform + batch assembly
+throughput (images/sec) through `data.ShardedLoader`, for both the native
+C++ dataplane (native/dataplane.cpp via data/native.py) and the Python/PIL
+fallback, against a self-generated on-disk image folder.
+
+Prints one JSON line per mode plus a summary line comparing the best host
+rate to the chip's consumption rate (--chip-rate, default the measured
+flagship ResNet-50 rate), e.g.:
+
+    {"metric": "input_native_images_per_sec", "value": ..., ...}
+    {"metric": "input_python_images_per_sec", "value": ..., ...}
+    {"metric": "input_pipeline_headroom", "value": best/chip_rate, ...}
+
+Reference counterpart: `DataLoader(num_workers=4, pin_memory=True)`
+(BASELINE/main.py:130-131) — the reference never measured it either;
+SURVEY §7.3 ranks input throughput the #1 hard part.
+
+Usage: python bench_input.py [--root DIR] [--images N] [--batch N]
+                             [--workers N] [--chip-rate R]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+
+def ensure_dataset(root: str, n_images: int, src_size: int, classes: int = 8) -> None:
+    """Generate a deterministic JPEG image folder once (smooth low-frequency
+    content + noise — realistic decode cost, unlike pure noise which inflates
+    file sizes)."""
+    from PIL import Image
+
+    done = os.path.join(root, ".complete")
+    if os.path.exists(done):
+        return
+    rng = np.random.default_rng(0)
+    per_class = n_images // classes
+    for c in range(classes):
+        d = os.path.join(root, f"class{c:03d}")
+        os.makedirs(d, exist_ok=True)
+        for i in range(per_class):
+            low = rng.integers(0, 255, (src_size // 16, src_size // 16, 3), np.uint8)
+            img = np.asarray(
+                Image.fromarray(low).resize((src_size, src_size), Image.BILINEAR),
+                np.int16,
+            )
+            img = np.clip(img + rng.integers(-20, 20, img.shape), 0, 255).astype(np.uint8)
+            Image.fromarray(img).save(
+                os.path.join(d, f"img{i:04d}.jpg"), quality=85
+            )
+    with open(done, "w") as f:
+        f.write("ok")
+
+
+def bench_mode(ds, batcher, batch: int, workers: int, epochs: int) -> float:
+    """images/sec through ShardedLoader over `epochs` full passes (first
+    pass warms page cache + pools and is excluded)."""
+    from ddp_classification_pytorch_tpu.data import ShardedLoader
+
+    loader = ShardedLoader(
+        ds, batch, shuffle=True, num_workers=workers, prefetch=4,
+        host_id=0, num_hosts=1, batcher=batcher,
+    )
+    try:
+        n = 0
+        for epoch in range(epochs + 1):
+            loader.set_epoch(epoch)
+            if epoch == 1:
+                t0 = time.perf_counter()
+            for images, labels in loader:
+                if epoch >= 1:
+                    n += len(labels)
+        return n / (time.perf_counter() - t0)
+    finally:
+        loader.close()
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--root", default="/tmp/bench_imgds")
+    ap.add_argument("--images", type=int, default=1024)
+    ap.add_argument("--src-size", type=int, default=320,
+                    help="source JPEG side — decode cost driver")
+    ap.add_argument("--image-size", type=int, default=224)
+    ap.add_argument("--batch", type=int, default=256)
+    ap.add_argument("--epochs", type=int, default=2, help="timed passes")
+    ap.add_argument("--workers", type=int, default=0, help="0 = cpu count")
+    ap.add_argument("--chip-rate", type=float, default=2550.0,
+                    help="chip consumption rate to compare against "
+                         "(flagship bench.py images/sec/chip)")
+    args = ap.parse_args()
+    workers = args.workers or (os.cpu_count() or 4)
+
+    from ddp_classification_pytorch_tpu.data import (
+        ImageFolderDataset,
+        NativeBatcher,
+        build_transform,
+    )
+
+    ensure_dataset(args.root, args.images, args.src_size)
+    tf = build_transform("baseline", train=True, image_size=args.image_size)
+    ds = ImageFolderDataset.from_root(args.root, tf)
+
+    rates = {}
+    if NativeBatcher.available():
+        batcher = NativeBatcher(ds, "baseline", train=True,
+                                image_size=args.image_size,
+                                crop_size=tf.out_size, seed=0,
+                                num_threads=workers)
+        rates["native"] = bench_mode(ds, batcher, args.batch, workers, args.epochs)
+    else:
+        print("# native dataplane unavailable — Python path only", file=sys.stderr)
+    rates["python"] = bench_mode(ds, None, args.batch, workers, args.epochs)
+
+    for mode, rate in rates.items():
+        print(json.dumps({
+            "metric": f"input_{mode}_images_per_sec",
+            "value": round(rate, 1),
+            "unit": "images/sec/host",
+            "workers": workers,
+        }))
+    best = max(rates.values())
+    print(json.dumps({
+        "metric": "input_pipeline_headroom",
+        "value": round(best / args.chip_rate, 3),
+        "unit": f"x chip rate ({args.chip_rate:g} img/s)",
+    }))
+
+
+if __name__ == "__main__":
+    main()
