@@ -49,33 +49,50 @@ def cdr_clip_schedule(noise_rate: float, num_gradual: int, n_epochs: int,
 
 
 class CDRState(NamedTuple):
-    pass
+    # optimizer-step counter driving the epoch-indexed clip schedule; lives
+    # in the opt state so the whole schedule stays inside the jitted update
+    step: jax.Array
 
 
 def cdr_gradient_transform(
     nonzero_ratio: float,
     clip: Optional[float] = None,
+    clip_schedule: Optional[np.ndarray] = None,
+    steps_per_epoch: int = 1,
 ) -> optax.GradientTransformationExtraArgs:
     """optax transform applying the CDR top-|g·v| mask.
 
     `nonzero_ratio` may be a python float (static fraction); `clip` defaults
     to `nonzero_ratio` exactly as the reference calls it
     (CDR/main.py:243 passes clip == nonzero_ratio == 1-noise_rate).
-    The caller may instead thread a per-epoch `clip` array through
-    `update(..., clip=...)` for the intended gradual schedule.
+
+    `clip_schedule` enables the *intended* gradual schedule the reference
+    computes but never uses (CDR/main.py:222-226): a per-epoch clip array
+    (see `cdr_clip_schedule(dead_schedule=False)`); the transform counts its
+    own optimizer steps and indexes `epoch = step // steps_per_epoch`,
+    clamped to the last entry — no host round-trip, no re-jit per epoch.
+    A `clip_override` kwarg at update time takes precedence over both.
     """
     if clip is None:
         clip = nonzero_ratio
+    sched = (None if clip_schedule is None
+             else np.asarray(clip_schedule, np.float32))
 
     def init_fn(params):
         del params
-        return CDRState()
+        return CDRState(step=jnp.zeros((), jnp.int32))
 
     def update_fn(updates, state, params=None, *, clip_override=None, **extra):
         del extra
         if params is None:
             raise ValueError("cdr_gradient_transform requires params")
-        clip_val = clip if clip_override is None else clip_override
+        if clip_override is not None:
+            clip_val = clip_override
+        elif sched is not None:
+            epoch = jnp.minimum(state.step // steps_per_epoch, len(sched) - 1)
+            clip_val = jnp.asarray(sched)[epoch]
+        else:
+            clip_val = clip
 
         leaves_g, treedef = jax.tree_util.tree_flatten(updates)
         leaves_v = jax.tree_util.tree_leaves(params)
@@ -96,6 +113,7 @@ def cdr_gradient_transform(
                 new_leaves.append(g * mask)
             else:
                 new_leaves.append(g)
-        return jax.tree_util.tree_unflatten(treedef, new_leaves), state
+        new_state = CDRState(step=state.step + 1)
+        return jax.tree_util.tree_unflatten(treedef, new_leaves), new_state
 
     return optax.GradientTransformationExtraArgs(init_fn, update_fn)

@@ -23,7 +23,7 @@ import jax.numpy as jnp
 import optax
 
 from ..config import OptimConfig
-from ..ops.cdr import cdr_gradient_transform
+from ..ops.cdr import cdr_clip_schedule, cdr_gradient_transform
 
 
 def build_schedule(cfg: OptimConfig, steps_per_epoch: int,
@@ -86,8 +86,19 @@ def build_optimizer(
 
     parts = []
     if cfg.grad_transform == "cdr":
-        clip = None if not cfg.cdr_dead_schedule else (1.0 - cfg.noise_rate)
-        parts.append(cdr_gradient_transform(1.0 - cfg.noise_rate, clip))
+        nz = 1.0 - cfg.noise_rate
+        if cfg.cdr_dead_schedule:
+            # reference's actual behavior: constant clip (CDR/main.py:227)
+            parts.append(cdr_gradient_transform(nz, nz))
+        else:
+            # the intended gradual ramp (CDR/main.py:222-226): clip 1.0 at
+            # epoch 0 down to 1-noise_rate by epoch num_gradual, constant
+            # after — indexed in-jit off the transform's own step counter
+            sched = cdr_clip_schedule(cfg.noise_rate, cfg.num_gradual,
+                                      cfg.num_gradual, dead_schedule=False)
+            parts.append(cdr_gradient_transform(
+                nz, clip_schedule=sched,
+                steps_per_epoch=max(steps_per_epoch // max(grad_accum, 1), 1)))
     if cfg.weight_decay:
         parts.append(optax.add_decayed_weights(cfg.weight_decay))
     parts.append(base)
