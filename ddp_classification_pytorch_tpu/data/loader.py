@@ -101,6 +101,23 @@ class ShardedLoader:
         self.host_id = host_id
         self.num_hosts = num_hosts
         self.epoch = 0
+        # one pool for the loader's lifetime — a per-batch pool would pay
+        # thread spawn/teardown on every batch of every epoch
+        self._pool = (
+            ThreadPoolExecutor(self.num_workers) if self.num_workers > 1 else None
+        )
+
+    def close(self) -> None:
+        """Release worker threads (idempotent; also runs at GC)."""
+        if self._pool is not None:
+            self._pool.shutdown(wait=False, cancel_futures=True)
+            self._pool = None
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
 
     def set_epoch(self, epoch: int) -> None:
         """Reshuffle hook (reference sampler.set_epoch, BASELINE/main.py:269)."""
@@ -142,9 +159,8 @@ class ShardedLoader:
             # the trailing index is positional bookkeeping we recover from `i`
             return item[0], item[1]
 
-        if self.num_workers > 1:
-            with ThreadPoolExecutor(self.num_workers) as ex:
-                items = list(ex.map(load, enumerate(indices)))
+        if self._pool is not None:
+            items = list(self._pool.map(load, enumerate(indices)))
         else:
             items = [load(ji) for ji in enumerate(indices)]
         images = np.stack([im for im, _ in items])

@@ -142,8 +142,11 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
 def _flash_forward(q3, k3, v3, scale, causal=False):
     """(bh, T, D) ×3 → (out (bh, T, D), lse (bh, T, 1) f32)."""
     bh, t, d = q3.shape
-    bq = _block(t)
-    bk = _block(t)
+    # cap 512 matches the backward's VMEM reasoning: at 1024 blocks with
+    # d=128, the (bq, bk) f32 score+probability tiles (~8 MB) plus operands
+    # and double-buffered K/V approach the 16 MB budget on some generations
+    bq = _block(t, cap=512)
+    bk = _block(t, cap=512)
     grid = (bh, t // bq, t // bk)
     if causal:
         # Above-diagonal steps are compute-skipped in the kernel; clamping

@@ -76,7 +76,12 @@ def arc_margin_ce_sharded(
     size; labels: (B,) int32. Returns replicated scalars
     (loss, top1_count, topk_count) over the GLOBAL batch — identical values
     to `CE(arc_margin_logits(...), labels)` + rank-count metrics, without a
-    (B, C) tensor on any device.
+    (B, C) tensor on any device. One caveat: on EXACT logit ties at the
+    top-k boundary, the merge breaks ties by all-gather position (shard
+    order), which can differ from dense `lax.top_k`'s class-index order —
+    counts may then diverge from the dense metric by the tied entries
+    (measure-zero with real-valued features; asserted-identical tests use
+    untied logits).
 
     `valid` (B,) 0/1 masks loader wrap-padding (eval): masked rows drop out
     of the loss numerator and the counts, and the loss denominator becomes

@@ -158,10 +158,13 @@ def _spec_for_param(path: str, value: Any, model_axis_size: int) -> P:
         return P()
     if "margin" in path and path.endswith("weight']") and value.ndim == 2:
         return P(MODEL_AXIS, None)
-    if "moe_" in path and "moe_router" not in path and (
+    if any(f"'{name}'" in path for name in
+           ("moe_w_in", "moe_b_in", "moe_w_out", "moe_b_out")) and (
             value.shape[0] % model_axis_size == 0):
-        # MoE expert banks (E, ...): expert dim → expert-parallel shards
-        # (ops/moe.py); the router stays replicated (every token gates over
+        # Exactly the MoE expert banks (E, ...) — matched by name, not by a
+        # 'moe_' substring, so a future moe_-prefixed non-bank param can't be
+        # silently expert-sharded. Expert dim → expert-parallel shards
+        # (ops/moe.py); moe_router stays replicated (every token gates over
         # every expert)
         return P(*([MODEL_AXIS] + [None] * (value.ndim - 1)))
     if value.ndim == 2 and "kernel" in path and (

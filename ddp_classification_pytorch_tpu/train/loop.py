@@ -252,13 +252,17 @@ class Trainer:
     def evaluate(self) -> Dict[str, float]:
         if self.nested_eval_step is not None:
             return self._evaluate_nested()
-        totals = {"loss_sum": 0.0, "top1": 0.0, "top3": 0.0, "n": 0.0}
+        totals = None  # device-side accumulation: a float() per batch would
+        # serialize eval dispatch (4 device-gets/batch); sync once at the end
         for b_idx, (images, labels) in enumerate(self.val_loader):
             valid = self.val_loader.valid_mask(b_idx)
             batch = meshlib.make_global_array((images, labels, valid), self.mesh)
             out = self.eval_step(self.state, *batch)
-            for k in totals:
-                totals[k] += float(out[k])
+            totals = out if totals is None else jax.tree_util.tree_map(
+                jax.numpy.add, totals, out)
+        if totals is None:
+            return {"val_loss": 0.0, "val_top1": 0.0, "val_top3": 0.0}
+        totals = {k: float(v) for k, v in totals.items()}  # the one host sync
         n = max(totals["n"], 1.0)
         return {
             "val_loss": totals["loss_sum"] / n,
@@ -267,15 +271,17 @@ class Trainer:
         }
 
     def _evaluate_nested(self) -> Dict[str, float]:
-        t1 = t3 = None
-        n = 0.0
+        t1 = t3 = n_dev = None  # accumulate on device; one sync at the end
         for b_idx, (images, labels) in enumerate(self.val_loader):
             valid = self.val_loader.valid_mask(b_idx)
             batch = meshlib.make_global_array((images, labels, valid), self.mesh)
             out = self.nested_eval_step(self.state, *batch)
             t1 = out["top1_k"] if t1 is None else t1 + out["top1_k"]
             t3 = out["top3_k"] if t3 is None else t3 + out["top3_k"]
-            n += float(out["n"])
+            n_dev = out["n"] if n_dev is None else n_dev + out["n"]
+        if t1 is None:  # val set smaller than one global batch
+            return {"val_top1": 0.0, "val_top3": 0.0, "best_k": 0}
+        n = float(n_dev)
         acc, k = best_k(t1, np.float32(max(n, 1.0)))
         return {
             "val_top1": float(acc),

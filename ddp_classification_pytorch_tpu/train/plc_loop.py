@@ -112,17 +112,20 @@ class PLCTrainer(Trainer):
             batcher=self.train_loader.batcher,
         )
         local_chunks = []  # this host's rows of each global batch
-        for images, _ in loader:
-            batch = meshlib.make_global_array((images, None), self.mesh)
-            logits = self.predict_step(self.state, batch[0])
-            # gather ONLY the addressable (this-host) shard rows — exact on
-            # any pod topology, no cross-host transfer. Dedup by row range:
-            # with a >1 'model' axis the row shards are replicated across it.
-            by_start = {}
-            for s in logits.addressable_shards:
-                by_start.setdefault(s.index[0].start or 0, s)
-            local_chunks.append(np.concatenate(
-                [np.asarray(by_start[k].data) for k in sorted(by_start)]))
+        try:
+            for images, _ in loader:
+                batch = meshlib.make_global_array((images, None), self.mesh)
+                logits = self.predict_step(self.state, batch[0])
+                # gather ONLY the addressable (this-host) shard rows — exact on
+                # any pod topology, no cross-host transfer. Dedup by row range:
+                # with a >1 'model' axis the row shards are replicated across it.
+                by_start = {}
+                for s in logits.addressable_shards:
+                    by_start.setdefault(s.index[0].start or 0, s)
+                local_chunks.append(np.concatenate(
+                    [np.asarray(by_start[k].data) for k in sorted(by_start)]))
+        finally:
+            loader.close()  # per-epoch loader: release its worker pool now
         local = np.concatenate(local_chunks, axis=0)
 
         if _jax.process_count() == 1:
