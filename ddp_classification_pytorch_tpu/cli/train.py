@@ -50,6 +50,11 @@ def build_parser() -> argparse.ArgumentParser:
                    help="per-class cap (500 baseline / 400 arcface)")
     d.add_argument("--num_workers", type=int, default=0, help="host loader threads")
     d.add_argument("--image_size", type=int, default=0)
+    d.add_argument("--transform", default="",
+                   help="transform preset for imagefolder data: baseline | "
+                        "cdr | cifar | clothing1m (default: workload preset; "
+                        "'cifar' = pad-4 random crop + flip at --image_size, "
+                        "for small-image folders)")
 
     m = p.add_argument_group("model")
     m.add_argument("--model", "--arch", dest="model", default="",
@@ -200,6 +205,8 @@ def config_from_args(args: argparse.Namespace) -> Config:
         cfg.data.num_workers = args.num_workers
     if args.image_size:
         cfg.data.image_size = args.image_size
+    if args.transform:
+        cfg.data.transform = args.transform
 
     if args.model:
         cfg.model.arch = args.model
@@ -310,6 +317,14 @@ def main(argv: Optional[Sequence[str]] = None) -> None:
 
     if args.platform:
         jax.config.update("jax_platforms", args.platform)
+    else:
+        # honor JAX_PLATFORMS even under a sitecustomize that pins the TPU
+        # plugin (env alone does not switch the platform there — observed:
+        # JAX_PLATFORMS=cpu still initialized the tunneled TPU backend and
+        # hung in its lease poll)
+        from ..utils.backend_probe import pin_platform_from_env
+
+        pin_platform_from_env()
     if args.multihost:
         jax.distributed.initialize()
     if args.world_size is not None or args.local_rank is not None:

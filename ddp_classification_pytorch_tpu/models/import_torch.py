@@ -141,6 +141,182 @@ def convert_resnet_state_dict(
     return out
 
 
+def _set(out: Dict[str, Dict], coll: str, path: Tuple[str, ...], leaf: str,
+         arr: np.ndarray) -> None:
+    node = out[coll]
+    for p in path:
+        node = node.setdefault(p, {})
+    node[leaf] = arr
+
+
+def _bn_leaf(leaf: str) -> Tuple[str, str]:
+    return {
+        "weight": ("scale", "params"),
+        "bias": ("bias", "params"),
+        "running_mean": ("mean", "batch_stats"),
+        "running_var": ("var", "batch_stats"),
+    }[leaf]
+
+
+def convert_vgg_state_dict(
+    state_dict: Mapping[str, Any],
+    include_fc: bool = True,
+) -> Dict[str, Dict]:
+    """torchvision `vgg19_bn` state_dict → the Flax VGG tree (models/vgg.py).
+
+    Layout handled: `features.<seq>.<leaf>` where <seq> walks cfg-E's
+    Sequential (conv, bn, relu per conv entry; one slot per maxpool), and
+    `classifier.{0,3,6}` → fc1/fc2/fc3. The reference loads exactly these
+    weights for its VGG feature extractor (NESTED/model/vgg.py:13-17).
+    `include_fc=False` drops the final 4096→1000 classifier (fc3) — the
+    feature-extractor role keeps fc1/fc2 (forward1 ends at fc2).
+
+    torchvision flattens pooled maps in CHW order while the NHWC model
+    flattens HWC — the fc1 kernel's input dim is permuted accordingly, so
+    outputs are numerically identical.
+    """
+    from .vgg import _CFG_E
+
+    # features.<seq> → flax module name
+    seq_map: Dict[str, Tuple[str, bool]] = {}
+    seq = i = 0
+    for v in _CFG_E:
+        if v == "M":
+            seq += 1
+        else:
+            seq_map[str(seq)] = (f"conv{i}", True)
+            seq_map[str(seq + 1)] = (f"bn{i}", False)
+            seq += 3
+            i += 1
+
+    out: Dict[str, Dict] = {"params": {}, "batch_stats": {}}
+    for key, value in state_dict.items():
+        if key.endswith("num_batches_tracked"):
+            continue
+        parts = key.split(".")
+        if parts[0] == "features":
+            name, is_conv = seq_map[parts[1]]
+            if is_conv:
+                arr = (_conv_kernel(value) if parts[2] == "weight"
+                       else _to_numpy(value))
+                _set(out, "params", (name,),
+                     "kernel" if parts[2] == "weight" else "bias", arr)
+            else:
+                leaf, coll = _bn_leaf(parts[2])
+                _set(out, coll, (name,), leaf, _to_numpy(value))
+        elif parts[0] == "classifier":
+            name = {"0": "fc1", "3": "fc2", "6": "fc3"}[parts[1]]
+            if name == "fc3" and not include_fc:
+                continue
+            arr = _to_numpy(value)
+            if parts[2] == "weight":
+                if name == "fc1":
+                    # (4096, C·H·W) CHW-ordered input → HWC order, then (I, O)
+                    o = arr.shape[0]
+                    arr = arr.reshape(o, 512, 7, 7).transpose(0, 2, 3, 1).reshape(o, -1)
+                arr = arr.T
+                _set(out, "params", (name,), "kernel", arr)
+            else:
+                _set(out, "params", (name,), "bias", arr)
+        else:
+            raise KeyError(f"unrecognized torch VGG key {key!r}")
+    if not out["params"]:
+        raise ValueError("checkpoint contained no convertible VGG weights")
+    return out
+
+
+def convert_tresnet_state_dict(
+    state_dict: Mapping[str, Any],
+    include_fc: bool = True,
+) -> Dict[str, Dict]:
+    """timm `tresnet_m` state_dict → the Flax TResNet tree
+    (models/tresnet.py, which mirrors timm's topology exactly).
+
+    Layout handled (timm tresnet.py): `body.conv1.{0,1}` stem conv2d_ABN;
+    `body.layer{L}.{B}.conv{j}` as conv2d_ABN pairs — `.0.weight`/`.1.*`
+    plain, or `.0.0.weight`/`.0.1.*` when wrapped with the anti-alias
+    blur (whose fixed `.filt` buffer is skipped); `se.fc{1,2}` 1×1-conv SE
+    (squeezed to Dense kernels); `downsample.1.{0,1}` avg-pool shortcut
+    conv2d_ABN; `head.fc`. Stages 1-2 are BasicBlocks (conv2 feeds the
+    identity-ABN `bn2`), stages 3-4 Bottlenecks (`bn3`) — the TResNet-M
+    plan (BASELINE/main.py:141-144 loads exactly this variant).
+    """
+    out: Dict[str, Dict] = {"params": {}, "batch_stats": {}}
+
+    def abn_target(layer: int, j: int) -> str:
+        basic = layer in (1, 2)
+        last = 2 if basic else 3
+        return f"bn{last}" if j == last else f"abn{j}"
+
+    for key, value in state_dict.items():
+        if key.endswith("num_batches_tracked") or key.endswith(".filt"):
+            continue
+        k = key[5:] if key.startswith("body.") else key
+        parts = k.split(".")
+        if parts[0] == "conv1":  # stem conv2d_ABN
+            if parts[1] == "0":
+                _set(out, "params", ("stem_conv",), "kernel", _conv_kernel(value))
+            else:
+                leaf, coll = _bn_leaf(parts[2])
+                _set(out, coll, ("stem_abn",), leaf, _to_numpy(value))
+            continue
+        if parts[0] == "head" or parts[0] == "fc":
+            if not include_fc:
+                continue
+            p = parts[-1]
+            arr = _to_numpy(value)
+            _set(out, "params", ("fc",),
+                 "kernel" if p == "weight" else "bias",
+                 arr.T if p == "weight" else arr)
+            continue
+        m = re.fullmatch(r"layer(\d+)", parts[0])
+        if m is None:
+            raise KeyError(f"unrecognized timm TResNet key {key!r}")
+        layer = int(m.group(1))
+        block = f"stage{layer}_block{parts[1]}"
+        sub, rest = parts[2], parts[3:]
+        mc = re.fullmatch(r"conv(\d+)", sub)
+        if mc:
+            j = int(mc.group(1))
+            if rest[:2] == ["0", "0"] or rest[:2] == ["0", "1"]:
+                rest = rest[1:]  # aa-wrapped: conv{j}.0.{0,1} → {0,1}
+            if rest[0] == "0":
+                _set(out, "params", (block, f"conv{j}"), "kernel",
+                     _conv_kernel(value))
+            else:
+                leaf, coll = _bn_leaf(rest[1])
+                _set(out, coll, (block, abn_target(layer, j)), leaf,
+                     _to_numpy(value))
+            continue
+        if sub == "se":
+            arr = _to_numpy(value)
+            if rest[1] == "weight":  # 1×1 conv (O, I, 1, 1) → Dense (I, O)
+                arr = arr.reshape(arr.shape[0], arr.shape[1]).T
+            _set(out, "params", (block, "se", rest[0]),
+                 "kernel" if rest[1] == "weight" else "bias", arr)
+            continue
+        if sub == "downsample":
+            # stride-2: downsample.1.{0,1} (avg-pool at .0 has no params);
+            # stride-1 (not in TResNet-M): downsample.{0,1} directly
+            if len(rest) == 3:  # ['1', '0'|'1', leaf]
+                conv_here = rest[1] == "0"
+                leaf_name = rest[2]
+            else:  # ['0'|'1', leaf]
+                conv_here = rest[0] == "0"
+                leaf_name = rest[1]
+            if conv_here:
+                _set(out, "params", (block, "downsample"), "kernel",
+                     _conv_kernel(value))
+            else:
+                leaf, coll = _bn_leaf(leaf_name)
+                _set(out, coll, (block, "bn_down"), leaf, _to_numpy(value))
+            continue
+        raise KeyError(f"unrecognized timm TResNet key {key!r}")
+    if not out["params"]:
+        raise ValueError("checkpoint contained no convertible TResNet weights")
+    return out
+
+
 def merge_into_variables(variables: Dict, converted: Dict) -> Dict:
     """Overlay converted arrays onto an initialized Flax variables tree,
     validating shapes; leaves absent from the checkpoint keep their init."""

@@ -1,22 +1,27 @@
-"""TResNet-M backbone — the reference's `timm` high-throughput option.
+"""Flax TResNet-M backbone — the reference's `timm` high-throughput option.
 
 Parity target: `timm.create_model('tresnet_m_miil_in21k', num_classes=...)`
 selected by `--model timm` (BASELINE/main.py:141-144), whose native
 dependency is the `inplace_abn` CUDA extension (requirements.txt:5-8). Here
-every ABN site uses `ops.pallas_kernels` — the Pallas fused
+every activated ABN site uses `ops.pallas_kernels` — the Pallas fused
 BatchNorm+LeakyReLU with exact VJP — so the model is TPU-native end to end.
 
 Architecture (TResNet: "TResNet: High Performance GPU-Dedicated
-Architecture", Ridnik et al. 2020), re-derived for NHWC/XLA:
-- SpaceToDepth stem (×4 patchify → conv 3×3) instead of conv7×7+maxpool —
-  a reshape/transpose XLA fuses for free, MXU-friendly from layer 1;
-- stages [3, 4, 11, 3] for TResNet-M: BasicBlock in stages 1-2,
-  Bottleneck in 3-4; widths 64·s, 128·s, 256·s, 512·s (s=1 for M);
-- Leaky-ReLU (slope 1e-3) everywhere via the fused ABN kernel;
-- SE blocks in stages 1-3 (reduction 4 basic / 8 bottleneck);
-- anti-aliased stride-2 downsampling approximated by the standard strided
-  conv (the blur-pool filter is a fixed 3×3 depthwise conv — included,
-  since it is one cheap fused conv on TPU).
+Architecture", Ridnik et al. 2020), laid out NHWC for XLA but
+structurally EXACT to timm's `tresnet.py` so pretrained checkpoints import
+weight-for-weight (models/import_torch.py::convert_tresnet_state_dict):
+- SpaceToDepth stem (x4 patchify, (bh, bw, c) channel order matching timm's
+  permute) -> conv 3x3 + ABN — a reshape XLA fuses for free, MXU-friendly
+  from layer 1;
+- stages [3, 4, 11, 3] for TResNet-M: BasicBlock in stages 1-2, Bottleneck
+  in 3-4; widths 64/128/256/512;
+- Leaky-ReLU (slope 1e-3) on activated ABNs; identity ABNs are plain BN;
+- stride-2 paths are conv+ABN followed by the fixed 3x3 binomial blur-pool
+  (timm AntiAliasDownsampleLayer: non-learned filter, stride 2, pad 1);
+- shortcut downsample: 2x2 avg-pool (stride 2) then 1x1 conv + identity ABN;
+- SE in stages 1-3 with timm's reduced widths: basic
+  max(planes*exp//4, 64) on the block output, bottleneck
+  max(planes*exp//8, 64) on the mid width between conv2 and conv3.
 """
 
 from __future__ import annotations
@@ -64,16 +69,18 @@ class FusedABN(nn.Module):
 
 
 def space_to_depth(x: jnp.ndarray, block: int = 4) -> jnp.ndarray:
-    """(B, H, W, C) → (B, H/b, W/b, C·b²) — the TResNet stem patchify."""
+    """(B, H, W, C) → (B, H/b, W/b, b²·C), channel order (bh, bw, c) —
+    identical to timm SpaceToDepth's permute, so stem conv weights import."""
     b, h, w, c = x.shape
     x = x.reshape(b, h // block, block, w // block, block, c)
     return x.transpose(0, 1, 3, 2, 4, 5).reshape(b, h // block, w // block, c * block * block)
 
 
 class BlurPool(nn.Module):
-    """Fixed 3×3 binomial depthwise blur + stride 2 (TResNet's anti-aliased
-    downsampling). The filter is a constant, not a parameter — one depthwise
-    conv XLA fuses with the adjacent strided conv."""
+    """Fixed 3×3 binomial depthwise blur, stride 2, pad (1,1) — timm's
+    AntiAliasDownsampleLayer (the filter is a constant buffer, not a
+    parameter); explicit torch-style padding keeps the sampling grid
+    aligned with the checkpoint's training-time grid."""
 
     @nn.compact
     def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
@@ -84,23 +91,24 @@ class BlurPool(nn.Module):
         k2 /= k2.sum()
         kernel = jnp.asarray(np.tile(k2[:, :, None, None], (1, 1, 1, c)), x.dtype)
         return lax.conv_general_dilated(
-            x, kernel, window_strides=(2, 2), padding="SAME",
+            x, kernel, window_strides=(2, 2), padding=((1, 1), (1, 1)),
             dimension_numbers=("NHWC", "HWIO", "NHWC"),
             feature_group_count=c,
         )
 
 
 class SE(nn.Module):
-    """Squeeze-excitation (TResNet places it after conv2 in basic blocks,
-    between conv2/conv3 in bottlenecks)."""
+    """Squeeze-excitation with an explicit reduced width (timm SEModule uses
+    1×1 convs; Dense is the same contraction in NHWC — weights import with a
+    squeeze)."""
 
-    reduction: int
+    reduced: int
 
     @nn.compact
     def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
         c = x.shape[-1]
         s = jnp.mean(x.astype(jnp.float32), axis=(1, 2))
-        s = nn.relu(nn.Dense(max(c // self.reduction, 8), name="fc1")(s))
+        s = nn.relu(nn.Dense(self.reduced, name="fc1")(s))
         s = nn.sigmoid(nn.Dense(c, name="fc2")(s))
         return x * s[:, None, None, :].astype(x.dtype)
 
@@ -117,22 +125,25 @@ class TBasicBlock(nn.Module):
     def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
         residual = x
         conv = functools.partial(nn.Conv, use_bias=False, dtype=self.dtype, padding="SAME")
-        y = conv(self.filters, (3, 3))(x) if self.strides == 1 else conv(
-            self.filters, (3, 3))(BlurPool(name="aa")(x))
-        y = self.abn()(y)
-        y = conv(self.filters, (3, 3))(y)
-        # final BN without activation: plain BatchNorm, relu applied after add
-        y = nn.BatchNorm(use_running_average=self.abn.keywords["use_running_average"],
-                         momentum=0.9, epsilon=1e-5, dtype=self.dtype, name="bn2")(y)
+        use_ra = self.abn.keywords["use_running_average"]
+        bn = functools.partial(nn.BatchNorm, use_running_average=use_ra,
+                               momentum=0.9, epsilon=1e-5, dtype=self.dtype)
+        # timm: conv+ABN at stride 1, then anti-alias blur when downsampling
+        y = self.abn(name="abn1")(conv(self.filters, (3, 3), name="conv1")(x))
+        if self.strides == 2:
+            y = BlurPool(name="aa")(y)
+        y = conv(self.filters, (3, 3), name="conv2")(y)
+        y = bn(name="bn2")(y)  # identity-activation ABN == plain BN
         if self.use_se:
-            y = SE(reduction=4, name="se")(y)
+            y = SE(reduced=max(self.filters * self.expansion // 4, 64),
+                   name="se")(y)
         if residual.shape != y.shape:
-            r = residual if self.strides == 1 else BlurPool(name="aa_down")(residual)
-            r = nn.Conv(self.filters, (1, 1), use_bias=False, dtype=self.dtype,
-                        name="downsample")(r)
-            residual = nn.BatchNorm(
-                use_running_average=self.abn.keywords["use_running_average"],
-                momentum=0.9, epsilon=1e-5, dtype=self.dtype, name="bn_down")(r)
+            r = residual
+            if self.strides == 2:
+                # timm shortcut: AvgPool2d(2, 2) before the 1×1 conv
+                r = nn.avg_pool(r, (2, 2), strides=(2, 2))
+            r = conv(self.filters * self.expansion, (1, 1), name="downsample")(r)
+            residual = bn(name="bn_down")(r)
         return nn.leaky_relu(y + residual, SLOPE)
 
 
@@ -148,22 +159,25 @@ class TBottleneck(nn.Module):
     def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
         residual = x
         conv = functools.partial(nn.Conv, use_bias=False, dtype=self.dtype, padding="SAME")
-        y = conv(self.filters, (1, 1))(x)
-        y = self.abn()(y)
-        y = conv(self.filters, (3, 3))(y if self.strides == 1 else BlurPool(name="aa")(y))
-        y = self.abn()(y)
+        use_ra = self.abn.keywords["use_running_average"]
+        bn = functools.partial(nn.BatchNorm, use_running_average=use_ra,
+                               momentum=0.9, epsilon=1e-5, dtype=self.dtype)
+        y = self.abn(name="abn1")(conv(self.filters, (1, 1), name="conv1")(x))
+        y = self.abn(name="abn2")(conv(self.filters, (3, 3), name="conv2")(y))
+        if self.strides == 2:
+            y = BlurPool(name="aa")(y)
         if self.use_se:
-            y = SE(reduction=8, name="se")(y)
-        y = conv(self.filters * self.expansion, (1, 1))(y)
-        y = nn.BatchNorm(use_running_average=self.abn.keywords["use_running_average"],
-                         momentum=0.9, epsilon=1e-5, dtype=self.dtype, name="bn3")(y)
+            # timm applies SE on the MID width between conv2 and conv3
+            y = SE(reduced=max(self.filters * self.expansion // 8, 64),
+                   name="se")(y)
+        y = conv(self.filters * self.expansion, (1, 1), name="conv3")(y)
+        y = bn(name="bn3")(y)
         if residual.shape != y.shape:
-            r = residual if self.strides == 1 else BlurPool(name="aa_down")(residual)
-            r = nn.Conv(self.filters * self.expansion, (1, 1), use_bias=False,
-                        dtype=self.dtype, name="downsample")(r)
-            residual = nn.BatchNorm(
-                use_running_average=self.abn.keywords["use_running_average"],
-                momentum=0.9, epsilon=1e-5, dtype=self.dtype, name="bn_down")(r)
+            r = residual
+            if self.strides == 2:
+                r = nn.avg_pool(r, (2, 2), strides=(2, 2))
+            r = conv(self.filters * self.expansion, (1, 1), name="downsample")(r)
+            residual = bn(name="bn_down")(r)
         return nn.leaky_relu(y + residual, SLOPE)
 
 

@@ -100,31 +100,35 @@ def create_train_state(
 
 
 def _load_pretrained(cfg: Config, variables):
-    """Overlay converted torchvision weights onto the backbone subtree
-    (reference `pretrained=True` defaults, BASELINE/main.py:135,
-    NESTED imagenet_resnet.py:195-203)."""
-    from ..models.import_torch import (
-        convert_resnet_state_dict,
-        load_torch_checkpoint,
-        merge_into_variables,
-    )
+    """Overlay converted torch weights onto the backbone subtree, choosing
+    the converter by arch (reference `pretrained=True` defaults:
+    torchvision ResNets BASELINE/main.py:135 / NESTED
+    imagenet_resnet.py:195-203; torchvision vgg19_bn NESTED/model/vgg.py:13-17;
+    timm tresnet_m_miil_in21k BASELINE/main.py:141-144)."""
+    from ..models import import_torch as it
 
-    sd = load_torch_checkpoint(cfg.model.pretrained_path)
+    sd = it.load_torch_checkpoint(cfg.model.pretrained_path)
     backbone_params = variables["params"]["backbone"]
-    # import the torchvision fc only when the model keeps a same-width fc
-    # (the reference always replaces it: 1000 → NUM_CLASS, BASELINE:136-139)
-    fc_kernel = backbone_params.get("fc", {}).get("kernel")
-    fc_w = sd.get("fc.weight")
-    include_fc = (
-        fc_kernel is not None and fc_w is not None
-        and tuple(fc_kernel.shape) == tuple(reversed(fc_w.shape))
-    )
-    converted = convert_resnet_state_dict(sd, include_fc=include_fc)
+    # (converter, flax head module, torch head key) per arch family; the
+    # torchvision/timm fc imports only when the model keeps a same-width
+    # head (the reference always replaces it: 1000 → NUM_CLASS,
+    # BASELINE:136-139; for VGG the replaceable head is fc3)
+    converter, flax_fc, torch_fc = {
+        "vgg19_bn": (it.convert_vgg_state_dict, "fc3", "classifier.6.weight"),
+        "tresnet_m": (it.convert_tresnet_state_dict, "fc", "head.fc.weight"),
+        "timm": (it.convert_tresnet_state_dict, "fc", "head.fc.weight"),
+    }.get(cfg.model.arch,
+          (it.convert_resnet_state_dict, "fc", "fc.weight"))
+    fc_kernel = backbone_params.get(flax_fc, {}).get("kernel")
+    w = sd.get(torch_fc)
+    include_fc = (fc_kernel is not None and w is not None
+                  and tuple(fc_kernel.shape) == tuple(reversed(w.shape)))
+    converted = converter(sd, include_fc=include_fc)
     sub = {
         "params": variables["params"]["backbone"],
         "batch_stats": variables.get("batch_stats", {}).get("backbone", {}),
     }
-    merged = merge_into_variables(sub, converted)
+    merged = it.merge_into_variables(sub, converted)
     out_params = dict(variables["params"])
     out_params["backbone"] = merged["params"]
     out = dict(variables)

@@ -200,3 +200,179 @@ def test_merge_rejects_shape_mismatch():
     bad = {"params": {"conv_stem": {"kernel": np.zeros((3, 3, 3, 63))}}}
     with pytest.raises(ValueError, match="shape mismatch"):
         merge_into_variables(variables, bad)
+
+
+# ------------------------------------------------------- VGG19-BN import ---
+
+def _vgg_torch_key(flax_path, leaf):
+    """Inverse of convert_vgg_state_dict's mapping (torchvision vgg19_bn)."""
+    from ddp_classification_pytorch_tpu.models.vgg import _CFG_E
+
+    bn_inv = {"scale": "weight", "bias": "bias", "mean": "running_mean",
+              "var": "running_var"}
+    name2seq = {}
+    seq = i = 0
+    for v in _CFG_E:
+        if v == "M":
+            seq += 1
+        else:
+            name2seq[f"conv{i}"] = seq
+            name2seq[f"bn{i}"] = seq + 1
+            seq += 3
+            i += 1
+    mod = flax_path[0]
+    if mod.startswith("conv"):
+        return f"features.{name2seq[mod]}.{'weight' if leaf == 'kernel' else 'bias'}"
+    if mod.startswith("bn"):
+        return f"features.{name2seq[mod]}.{bn_inv[leaf]}"
+    cl = {"fc1": "0", "fc2": "3", "fc3": "6"}[mod]
+    return f"classifier.{cl}.{'weight' if leaf == 'kernel' else 'bias'}"
+
+
+def test_vgg_state_dict_roundtrip_covers_every_leaf():
+    from ddp_classification_pytorch_tpu.models.import_torch import (
+        convert_vgg_state_dict,
+    )
+    from ddp_classification_pytorch_tpu.models.vgg import vgg19_bn
+
+    model = vgg19_bn(num_classes=13, dtype=jnp.float32)
+    variables = model.init(
+        {"params": jax.random.PRNGKey(0), "dropout": jax.random.PRNGKey(1)},
+        jnp.zeros((1, 64, 64, 3)), train=False)
+
+    rng = np.random.default_rng(4)
+    state_dict = {}
+    expected = {}
+    for coll in ("params", "batch_stats"):
+        flat = jax.tree_util.tree_flatten_with_path(variables[coll])[0]
+        for path, value in flat:
+            names = tuple(p.key for p in path)
+            key = _vgg_torch_key(names[:-1], names[-1])
+            arr = rng.normal(size=value.shape).astype(np.float32)
+            expected[(coll,) + names] = arr
+            if names[-1] == "kernel" and arr.ndim == 4:
+                state_dict[key] = arr.transpose(3, 2, 0, 1)  # HWIO → OIHW
+            elif names[-1] == "kernel" and names[-2] == "fc1":
+                o = arr.shape[1]
+                # flax (HWC-flat, O) → torch (O, CHW-flat)
+                state_dict[key] = (arr.T.reshape(o, 7, 7, 512)
+                                   .transpose(0, 3, 1, 2).reshape(o, -1))
+            elif names[-1] == "kernel":
+                state_dict[key] = arr.T
+            else:
+                state_dict[key] = arr
+    state_dict["features.1.num_batches_tracked"] = np.int64(7)  # skipped
+
+    converted = convert_vgg_state_dict(state_dict)
+    merged = merge_into_variables(variables, converted)
+    for coll in ("params", "batch_stats"):
+        flat = jax.tree_util.tree_flatten_with_path(merged[coll])[0]
+        for path, value in flat:
+            names = (coll,) + tuple(p.key for p in path)
+            np.testing.assert_allclose(
+                np.asarray(value), expected[names], atol=1e-6,
+                err_msg=str(names))
+
+
+def test_vgg_fc1_flatten_order_matches_torch():
+    """The CHW→HWC input-dim permutation on fc1 must keep the linear layer's
+    OUTPUT identical between torch (flattening NCHW) and flax (flattening
+    NHWC)."""
+    from ddp_classification_pytorch_tpu.models.import_torch import (
+        convert_vgg_state_dict,
+    )
+
+    rng = np.random.default_rng(5)
+    x_nchw = rng.normal(size=(2, 512, 7, 7)).astype(np.float32)
+    w = rng.normal(size=(16, 512 * 7 * 7)).astype(np.float32)
+    ref = x_nchw.reshape(2, -1) @ w.T  # torch fc1 forward
+
+    conv = convert_vgg_state_dict(
+        {"classifier.0.weight": w, "classifier.0.bias": np.zeros(16, np.float32)})
+    kernel = conv["params"]["fc1"]["kernel"]
+    out = x_nchw.transpose(0, 2, 3, 1).reshape(2, -1) @ kernel  # flax forward
+    np.testing.assert_allclose(out, ref, rtol=1e-4, atol=1e-4)
+
+
+# ------------------------------------------------------ TResNet-M import ---
+
+def _tresnet_torch_key(flax_path, leaf):
+    """Inverse of convert_tresnet_state_dict's mapping (timm tresnet_m)."""
+    import re as _re
+
+    bn_inv = {"scale": "weight", "bias": "bias", "mean": "running_mean",
+              "var": "running_var"}
+    p = flax_path
+    if p[0] == "stem_conv":
+        return "body.conv1.0.weight"
+    if p[0] == "stem_abn":
+        return f"body.conv1.1.{bn_inv[leaf]}"
+    if p[0] == "fc":
+        return f"head.fc.{'weight' if leaf == 'kernel' else 'bias'}"
+    m = _re.fullmatch(r"stage(\d+)_block(\d+)", p[0])
+    layer, block = int(m.group(1)), int(m.group(2))
+    prefix = f"body.layer{layer}.{block}"
+    basic = layer in (1, 2)
+    aa_conv = 1 if basic else 2  # conv wrapped with the blur at stride 2
+    stride2 = block == 0 and layer >= 2
+    sub = p[1]
+    if sub.startswith("conv"):
+        j = int(sub[4:])
+        mid = "0.0" if (stride2 and j == aa_conv) else "0"
+        return f"{prefix}.conv{j}.{mid}.weight"
+    if sub.startswith("abn") or sub in ("bn2", "bn3"):
+        j = int(sub[3:]) if sub.startswith("abn") else int(sub[2:])
+        mid = "0.1" if (stride2 and j == aa_conv) else "1"
+        return f"{prefix}.conv{j}.{mid}.{bn_inv[leaf]}"
+    if sub == "se":
+        return f"{prefix}.se.{p[2]}.{'weight' if leaf == 'kernel' else 'bias'}"
+    if sub == "downsample":
+        return f"{prefix}.downsample.1.0.weight"
+    if sub == "bn_down":
+        return f"{prefix}.downsample.1.1.{bn_inv[leaf]}"
+    raise AssertionError(flax_path)
+
+
+def test_tresnet_state_dict_roundtrip_covers_every_leaf():
+    from ddp_classification_pytorch_tpu.models.import_torch import (
+        convert_tresnet_state_dict,
+    )
+    from ddp_classification_pytorch_tpu.models.tresnet import tresnet_m
+
+    model = tresnet_m(num_classes=11, dtype=jnp.float32)
+    variables = model.init(jax.random.PRNGKey(0),
+                           jnp.zeros((1, 64, 64, 3)), train=False)
+
+    rng = np.random.default_rng(6)
+    state_dict = {}
+    expected = {}
+    for coll in ("params", "batch_stats"):
+        flat = jax.tree_util.tree_flatten_with_path(variables[coll])[0]
+        for path, value in flat:
+            names = tuple(p.key for p in path)
+            key = _tresnet_torch_key(names[:-1], names[-1])
+            arr = rng.normal(size=value.shape).astype(np.float32)
+            expected[(coll,) + names] = arr
+            if names[-1] == "kernel" and arr.ndim == 4:
+                state_dict[key] = arr.transpose(3, 2, 0, 1)  # HWIO → OIHW
+            elif (names[-1] == "kernel" and len(names) >= 3
+                    and names[-3] == "se"):
+                # Dense (I, O) → timm 1×1-conv (O, I, 1, 1)
+                state_dict[key] = arr.T[:, :, None, None]
+            elif names[-1] == "kernel":
+                state_dict[key] = arr.T
+            else:
+                state_dict[key] = arr
+    # fixed blur buffers + BN counters must be skipped
+    state_dict["body.layer2.0.conv1.1.filt"] = np.zeros((128, 1, 3, 3))
+    state_dict["body.conv1.1.num_batches_tracked"] = np.int64(3)
+
+    converted = convert_tresnet_state_dict(state_dict)
+    merged = merge_into_variables(variables, converted)
+    for coll in ("params", "batch_stats"):
+        flat = jax.tree_util.tree_flatten_with_path(merged[coll])[0]
+        for path, value in flat:
+            names = (coll,) + tuple(p.key for p in path)
+            np.testing.assert_allclose(
+                np.asarray(value), expected[names], atol=1e-6,
+                err_msg=str(names))
