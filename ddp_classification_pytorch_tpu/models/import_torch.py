@@ -41,14 +41,7 @@ def _conv_kernel(w: np.ndarray) -> np.ndarray:
 def _convert_key(key: str) -> Tuple[Tuple[str, ...], str, str]:
     """torch state_dict key → (flax module path, leaf name, collection)."""
     parts = key.split(".")
-
-    def bn_leaf(leaf: str) -> Tuple[str, str]:
-        return {
-            "weight": ("scale", "params"),
-            "bias": ("bias", "params"),
-            "running_mean": ("mean", "batch_stats"),
-            "running_var": ("var", "batch_stats"),
-        }[leaf]
+    bn_leaf = _bn_leaf
 
     if parts[0] == "conv1":
         return ("conv_stem",), "kernel", "params"
@@ -126,10 +119,7 @@ def convert_resnet_state_dict(
             arr = _conv_kernel(value)
         elif leaf == "kernel" and arr.ndim == 2:
             arr = arr.T  # linear (O, I) → (I, O)
-        node = out[coll]
-        for p in path:
-            node = node.setdefault(p, {})
-        node[leaf] = arr
+        _set(out, coll, path, leaf, arr)
     if not out["params"]:
         # a silently-empty conversion would leave the model at random init
         # while the user believes pretrained weights loaded
@@ -195,6 +185,11 @@ def convert_vgg_state_dict(
             continue
         parts = key.split(".")
         if parts[0] == "features":
+            if parts[1] not in seq_map:
+                raise KeyError(
+                    f"torch VGG key {key!r} does not fit the vgg19_bn cfg-E "
+                    "layout (only the BN variant the reference loads, "
+                    "NESTED/model/vgg.py:13-17, is supported)")
             name, is_conv = seq_map[parts[1]]
             if is_conv:
                 arr = (_conv_kernel(value) if parts[2] == "weight"
@@ -205,6 +200,10 @@ def convert_vgg_state_dict(
                 leaf, coll = _bn_leaf(parts[2])
                 _set(out, coll, (name,), leaf, _to_numpy(value))
         elif parts[0] == "classifier":
+            if parts[1] not in ("0", "3", "6"):
+                raise KeyError(
+                    f"torch VGG key {key!r}: classifier index not in the "
+                    "vgg19_bn Linear positions (0/3/6)")
             name = {"0": "fc1", "3": "fc2", "6": "fc3"}[parts[1]]
             if name == "fc3" and not include_fc:
                 continue

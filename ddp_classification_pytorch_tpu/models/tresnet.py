@@ -140,8 +140,14 @@ class TBasicBlock(nn.Module):
         if residual.shape != y.shape:
             r = residual
             if self.strides == 2:
-                # timm shortcut: AvgPool2d(2, 2) before the 1×1 conv
-                r = nn.avg_pool(r, (2, 2), strides=(2, 2))
+                # timm shortcut: AvgPool2d(2, 2, ceil_mode=True,
+                # count_include_pad=False) — pad the odd edge only, exclude
+                # the pad from the mean, so the shortcut's ceil(H/2) matches
+                # BlurPool's padded output on odd dims
+                h, w = r.shape[1], r.shape[2]
+                r = nn.avg_pool(r, (2, 2), strides=(2, 2),
+                                padding=((0, h % 2), (0, w % 2)),
+                                count_include_pad=False)
             r = conv(self.filters * self.expansion, (1, 1), name="downsample")(r)
             residual = bn(name="bn_down")(r)
         return nn.leaky_relu(y + residual, SLOPE)
@@ -175,7 +181,12 @@ class TBottleneck(nn.Module):
         if residual.shape != y.shape:
             r = residual
             if self.strides == 2:
-                r = nn.avg_pool(r, (2, 2), strides=(2, 2))
+                # ceil_mode avg-pool as in TBasicBlock (odd-dim parity with
+                # the blurred main path)
+                h, w = r.shape[1], r.shape[2]
+                r = nn.avg_pool(r, (2, 2), strides=(2, 2),
+                                padding=((0, h % 2), (0, w % 2)),
+                                count_include_pad=False)
             r = conv(self.filters * self.expansion, (1, 1), name="downsample")(r)
             residual = bn(name="bn_down")(r)
         return nn.leaky_relu(y + residual, SLOPE)

@@ -1,0 +1,66 @@
+"""CLI → Config mapping tests (no jax backend needed).
+
+The CLI is the reference's whole API surface (SURVEY L6); these pin the
+flag→field wiring that e2e tests are too slow to sweep.
+"""
+
+import pytest
+
+from ddp_classification_pytorch_tpu.cli.train import build_parser, config_from_args
+
+
+def _cfg(*argv):
+    return config_from_args(build_parser().parse_args(argv))
+
+
+def test_transform_flag_overrides_preset():
+    cfg = _cfg("baseline", "--transform", "cifar", "--image_size", "32")
+    assert cfg.data.transform == "cifar"
+    assert cfg.data.image_size == 32
+
+
+def test_transform_default_follows_workload_preset():
+    assert _cfg("baseline").data.transform == "baseline"
+    assert _cfg("cdr").data.transform == "cdr"
+
+
+def test_live_clip_schedule_flag_disables_dead_schedule():
+    cfg = _cfg("cdr", "--live_clip_schedule")
+    assert cfg.optim.cdr_dead_schedule is False
+    assert _cfg("cdr").optim.cdr_dead_schedule is True
+
+
+def test_lr_schedule_flag_sets_multistep_milestones():
+    cfg = _cfg("baseline", "--lrSchedule", "20", "32")
+    assert cfg.optim.schedule == "multistep"
+    assert tuple(cfg.optim.milestones) == (20, 32)
+
+
+def test_reference_compat_flags_parse():
+    # --world_size/--local_rank must parse (compat no-ops, SURVEY L6)
+    cfg = _cfg("baseline", "--world_size", "2", "--local_rank", "0")
+    assert cfg.workload == "baseline"
+
+
+def test_cifar_dataset_sets_facts_unless_overridden():
+    cfg = _cfg("baseline", "--dataset", "cifar10", "--train_dir", "/x")
+    assert cfg.data.num_classes == 10
+    assert cfg.data.image_size == 32
+    assert cfg.model.variant == "cifar"
+    cfg = _cfg("baseline", "--dataset", "cifar100", "--num_classes", "100",
+               "--image_size", "24")
+    assert cfg.data.num_classes == 100
+    assert cfg.data.image_size == 24
+
+
+def test_unknown_transform_rejected_at_build():
+    from ddp_classification_pytorch_tpu.data.transforms import build_transform
+
+    with pytest.raises(ValueError, match="unknown transform"):
+        build_transform("nope", train=True)
+
+
+def test_moe_aux_weight_validation():
+    with pytest.raises(SystemExit):
+        _cfg("baseline", "--model", "vit_t16", "--moe_experts", "4",
+             "--moe_aux_weight", "-0.5")

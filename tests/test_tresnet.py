@@ -69,3 +69,20 @@ def test_tresnet_train_step_runs():
             rng.integers(0, 4, 16).astype(np.int32), meshlib.batch_sharding(mesh))
         state, metrics = step(state, images, labels)
         assert np.isfinite(float(metrics["loss"]))
+
+
+def test_tresnet_odd_stage_dims_forward():
+    """image_size ≡ 4 (mod 8) makes stride-2 stage inputs odd: the ceil-mode
+    shortcut avg-pool must match BlurPool's padded output (regression: VALID
+    avg-pool floored the shortcut to a smaller map and the residual add
+    crashed)."""
+    import jax
+    import jax.numpy as jnp
+
+    from ddp_classification_pytorch_tpu.models.tresnet import tresnet_m
+
+    model = tresnet_m(num_classes=3, dtype=jnp.float32)
+    variables = model.init(jax.random.PRNGKey(0),
+                           jnp.zeros((1, 36, 36, 3)), train=False)
+    out = model.apply(variables, jnp.zeros((2, 36, 36, 3)), train=False)
+    assert out.shape == (2, 3)
