@@ -103,6 +103,10 @@ def build_parser() -> argparse.ArgumentParser:
     n.add_argument("--nested", type=float, default=-1.0,
                    help="Gaussian σ over feature dims (NESTED/train.py:512-530)")
     n.add_argument("--freeze-bn", dest="freeze_bn", default=None, action="store_true")
+    n.add_argument("--no-freeze-bn", dest="freeze_bn", action="store_false",
+                   help="train BN normally (the preset's freeze-BN mirrors "
+                        "NESTED/train.py:529, which assumes a pretrained "
+                        "backbone; from-scratch runs want live BN)")
     n.add_argument("--resumePth", default="", help="NESTED/train.py:481")
 
     pl = p.add_argument_group("plc")

@@ -25,6 +25,11 @@ def main() -> None:
     ap.add_argument("--root", default="/tmp/digits")
     ap.add_argument("--scale", type=int, default=4)
     ap.add_argument("--val_frac", type=float, default=0.2)
+    ap.add_argument("--noise_rate", type=float, default=0.0,
+                    help="symmetric label noise on the TRAIN split only "
+                         "(image written under a uniformly-wrong class dir; "
+                         "val stays clean) — the CDR/PLC robust-learning "
+                         "demo input (CDR/main.py:37 semantics)")
     args = ap.parse_args()
 
     from sklearn.datasets import load_digits
@@ -33,15 +38,24 @@ def main() -> None:
     imgs = (X.reshape(-1, 8, 8) * (255.0 / 16.0)).round().astype(np.uint8)
 
     rng = np.random.default_rng(0)
+    # separate stream for label corruption: the train/val SPLIT must be
+    # identical for every noise_rate, so clean-vs-noisy comparisons share
+    # one val set (noise draws must not advance the split rng)
+    noise_rng = np.random.default_rng(1)
     counts = {"train": 0, "val": 0}
     for cls in range(10):
         idx = np.nonzero(y == cls)[0]
         rng.shuffle(idx)
         n_val = int(len(idx) * args.val_frac)
         for split, members in (("val", idx[:n_val]), ("train", idx[n_val:])):
-            d = os.path.join(args.root, split, f"digit{cls}")
-            os.makedirs(d, exist_ok=True)
             for i in members:
+                label = cls
+                if split == "train" and args.noise_rate > 0 and (
+                        noise_rng.uniform() < args.noise_rate):
+                    label = int(noise_rng.choice(
+                        [c for c in range(10) if c != cls]))
+                d = os.path.join(args.root, split, f"digit{label}")
+                os.makedirs(d, exist_ok=True)
                 im = Image.fromarray(imgs[i], "L").resize(
                     (8 * args.scale, 8 * args.scale), Image.NEAREST)
                 im.convert("RGB").save(os.path.join(d, f"img{i:04d}.png"))

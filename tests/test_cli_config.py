@@ -64,3 +64,9 @@ def test_moe_aux_weight_validation():
     with pytest.raises(SystemExit):
         _cfg("baseline", "--model", "vit_t16", "--moe_experts", "4",
              "--moe_aux_weight", "-0.5")
+
+
+def test_freeze_bn_flag_pair():
+    assert _cfg("nested").model.freeze_bn is True  # preset (train.py:529)
+    assert _cfg("nested", "--no-freeze-bn").model.freeze_bn is False
+    assert _cfg("baseline", "--freeze-bn").model.freeze_bn is True
