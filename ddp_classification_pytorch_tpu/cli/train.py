@@ -89,6 +89,13 @@ def build_parser() -> argparse.ArgumentParser:
     a = p.add_argument_group("arcface")
     a.add_argument("--arc_s", type=float, default=-1.0)
     a.add_argument("--arc_m", type=float, default=-1.0)
+    a.add_argument("--head_lr", type=float, default=-1.0,
+                   help="lr for the margin-head param group (reference's "
+                        "optimizer group 2, arc_main.py:248-253); unset = "
+                        "inherit --lr")
+    a.add_argument("--head_weight_decay", type=float, default=-1.0,
+                   help="weight decay for the margin-head param group; "
+                        "unset = inherit --weight_decay")
     a.add_argument("--easy_margin", dest="easy_margin", default=None,
                    action="store_true")
 
@@ -248,6 +255,10 @@ def config_from_args(args: argparse.Namespace) -> Config:
         cfg.optim.momentum = args.momentum
     if args.weight_decay >= 0:
         cfg.optim.weight_decay = args.weight_decay
+    if args.head_lr >= 0:
+        cfg.optim.head_lr = args.head_lr
+    if args.head_weight_decay >= 0:
+        cfg.optim.head_weight_decay = args.head_weight_decay
     if args.lrSchedule is not None:
         cfg.optim.schedule = "multistep"
         cfg.optim.milestones = tuple(args.lrSchedule)

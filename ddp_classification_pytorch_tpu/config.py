@@ -11,7 +11,7 @@ from __future__ import annotations
 
 import dataclasses
 from dataclasses import dataclass, field
-from typing import Any, Sequence
+from typing import Any, Optional, Sequence
 
 
 @dataclass
@@ -111,6 +111,13 @@ class OptimConfig:
     lr: float = 1e-3
     momentum: float = 0.9
     weight_decay: float = 0.0
+    # Per-group hyperparameters for the head param group (ArcFace margin
+    # head — the reference builds ONE optimizer over TWO param groups,
+    # arc_main.py:248-253; its recipes use identical hyperparams per group,
+    # so None = inherit lr/weight_decay and the optimizer reduces to a
+    # single transform over the joint tree). Set to diverge the groups.
+    head_lr: Optional[float] = None
+    head_weight_decay: Optional[float] = None
     schedule: str = "step"  # step | multistep | constant
     step_size: int = 10
     gamma: float = 0.1

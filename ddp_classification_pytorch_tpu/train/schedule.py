@@ -17,6 +17,7 @@ jitted update — no host round-trip per step.
 
 from __future__ import annotations
 
+import dataclasses
 
 import jax
 import jax.numpy as jnp
@@ -67,6 +68,26 @@ def _is_bn_param(path, _value) -> bool:
     return "batchnorm" in keys or "bn_" in keys or keys.endswith("_bn") or "/bn" in keys
 
 
+def _group_tx(cfg: OptimConfig, schedule) -> optax.GradientTransformation:
+    """weight_decay + sgd/adam for ONE param group's hyperparams."""
+    if cfg.optimizer == "sgd":
+        base = optax.sgd(schedule, momentum=cfg.momentum)
+    elif cfg.optimizer == "adam":
+        base = optax.adam(schedule)
+    else:
+        raise ValueError(f"unknown optimizer {cfg.optimizer!r}")
+    if cfg.weight_decay:
+        return optax.chain(optax.add_decayed_weights(cfg.weight_decay), base)
+    return base
+
+
+# Top-level param-tree keys forming the "head" group when head_lr /
+# head_weight_decay diverge a second param group (the reference's optimizer
+# group 2 is the ArcMarginProduct module, arc_main.py:248-253; our
+# ArcFaceModel names that subtree "margin").
+HEAD_GROUP_KEYS = ("margin",)
+
+
 def build_optimizer(
     cfg: OptimConfig,
     steps_per_epoch: int,
@@ -75,14 +96,42 @@ def build_optimizer(
 ) -> optax.GradientTransformationExtraArgs:
     # with accumulation the schedule advances once per OPTIMIZER step, so the
     # per-epoch schedule length shrinks by the accumulation factor
-    schedule = build_schedule(cfg, max(steps_per_epoch // max(grad_accum, 1), 1),
-                              grad_accum=grad_accum)
-    if cfg.optimizer == "sgd":
-        base = optax.sgd(schedule, momentum=cfg.momentum)
-    elif cfg.optimizer == "adam":
-        base = optax.adam(schedule)
+    sched_steps = max(steps_per_epoch // max(grad_accum, 1), 1)
+    schedule = build_schedule(cfg, sched_steps, grad_accum=grad_accum)
+
+    if cfg.head_lr is not None or cfg.head_weight_decay is not None:
+        # Two param groups in one optimizer (arc_main.py:248-253): the head
+        # group (HEAD_GROUP_KEYS subtrees) runs its own lr/weight_decay
+        # through the SAME schedule shape; everything else is the base group.
+        head_cfg = dataclasses.replace(
+            cfg,
+            lr=cfg.lr if cfg.head_lr is None else cfg.head_lr,
+            weight_decay=(cfg.weight_decay if cfg.head_weight_decay is None
+                          else cfg.head_weight_decay),
+        )
+        head_sched = build_schedule(head_cfg, sched_steps, grad_accum=grad_accum)
+
+        def label_fn(params):
+            if not any(k in HEAD_GROUP_KEYS for k in params):
+                # silently training everything at the base hyperparams would
+                # hide the misconfiguration (e.g. --head_lr on baseline)
+                raise ValueError(
+                    f"head_lr/head_weight_decay set but no head param group "
+                    f"{HEAD_GROUP_KEYS} in the param tree (top-level keys: "
+                    f"{sorted(params)}); these flags apply to the ArcFace "
+                    f"margin head")
+            return {
+                k: jax.tree_util.tree_map(
+                    lambda _: "head" if k in HEAD_GROUP_KEYS else "base", v)
+                for k, v in params.items()
+            }
+
+        base = optax.multi_transform(
+            {"base": _group_tx(cfg, schedule),
+             "head": _group_tx(head_cfg, head_sched)},
+            label_fn)
     else:
-        raise ValueError(f"unknown optimizer {cfg.optimizer!r}")
+        base = _group_tx(cfg, schedule)
 
     parts = []
     if cfg.grad_transform == "cdr":
@@ -97,10 +146,8 @@ def build_optimizer(
             sched = cdr_clip_schedule(cfg.noise_rate, cfg.num_gradual,
                                       cfg.num_gradual, dead_schedule=False)
             parts.append(cdr_gradient_transform(
-                nz, clip_schedule=sched,
-                steps_per_epoch=max(steps_per_epoch // max(grad_accum, 1), 1)))
-    if cfg.weight_decay:
-        parts.append(optax.add_decayed_weights(cfg.weight_decay))
+                nz, clip_schedule=sched, steps_per_epoch=sched_steps))
+    # weight decay lives inside each group's transform (_group_tx)
     parts.append(base)
     if freeze_bn:
         # zero out BN parameter updates (running stats are already frozen by

@@ -146,3 +146,59 @@ def test_warmup_rescales_under_grad_accum():
     sched = build_schedule(cfg, steps_per_epoch=10, grad_accum=2)
     assert float(sched(4)) == pytest.approx(0.8)   # 4/5 through a 5-step ramp
     assert float(sched(5)) == pytest.approx(1.0)
+
+
+def test_head_param_group_hyperparams():
+    # The reference's single optimizer spans TWO param groups (backbone, ARC
+    # margin head — arc_main.py:248-253). head_lr/head_weight_decay diverge
+    # the groups; unset they inherit and the optimizer is one transform.
+    import jax.numpy as jnp
+
+    from ddp_classification_pytorch_tpu.train.schedule import build_optimizer
+
+    params = {
+        "backbone": {"w": jnp.ones((3,))},
+        "margin": {"weight": jnp.ones((3,))},
+    }
+    grads = {
+        "backbone": {"w": jnp.ones((3,))},
+        "margin": {"weight": jnp.ones((3,))},
+    }
+
+    cfg = OptimConfig(optimizer="sgd", momentum=0.0, lr=0.1, head_lr=0.2,
+                      schedule="constant")
+    tx = build_optimizer(cfg, steps_per_epoch=10)
+    updates, _ = tx.update(grads, tx.init(params), params)
+    assert float(updates["backbone"]["w"][0]) == pytest.approx(-0.1)
+    assert float(updates["margin"]["weight"][0]) == pytest.approx(-0.2)
+
+    # head_weight_decay=0 while base decays: only backbone feels the decay
+    cfg = OptimConfig(optimizer="sgd", momentum=0.0, lr=0.1,
+                      weight_decay=0.5, head_weight_decay=0.0,
+                      schedule="constant")
+    tx = build_optimizer(cfg, steps_per_epoch=10)
+    updates, _ = tx.update(grads, tx.init(params), params)
+    # base: -(lr·(g + wd·p)) = -0.1·1.5 ; head: -0.1·1.0
+    assert float(updates["backbone"]["w"][0]) == pytest.approx(-0.15)
+    assert float(updates["margin"]["weight"][0]) == pytest.approx(-0.1)
+
+    # unset → identical hyperparams per group, single-transform path
+    cfg = OptimConfig(optimizer="sgd", momentum=0.0, lr=0.1, schedule="constant")
+    tx = build_optimizer(cfg, steps_per_epoch=10)
+    updates, _ = tx.update(grads, tx.init(params), params)
+    assert float(updates["margin"]["weight"][0]) == pytest.approx(-0.1)
+
+
+def test_head_group_flags_reject_headless_tree():
+    # --head_lr on a workload without a margin head must fail loudly, not
+    # silently train everything at the base hyperparams
+    import jax.numpy as jnp
+
+    from ddp_classification_pytorch_tpu.train.schedule import build_optimizer
+
+    params = {"backbone": {"w": jnp.ones((3,))}}
+    cfg = OptimConfig(optimizer="sgd", momentum=0.0, lr=0.1, head_lr=0.2,
+                      schedule="constant")
+    tx = build_optimizer(cfg, steps_per_epoch=10)
+    with pytest.raises(ValueError, match="no head param group"):
+        tx.init(params)
