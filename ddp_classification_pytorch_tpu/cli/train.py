@@ -21,6 +21,7 @@ Every behavior-affecting reference flag maps to a field of the Config tree:
 from __future__ import annotations
 
 import argparse
+import os
 from typing import Optional, Sequence
 
 from ..config import Config, get_preset
@@ -328,6 +329,7 @@ def config_from_args(args: argparse.Namespace) -> Config:
 
 def main(argv: Optional[Sequence[str]] = None) -> None:
     args = build_parser().parse_args(argv)
+    cfg = config_from_args(args)  # cheap config errors surface before any probe
     import jax
 
     if args.platform:
@@ -340,6 +342,22 @@ def main(argv: Optional[Sequence[str]] = None) -> None:
         from ..utils.backend_probe import pin_platform_from_env
 
         pin_platform_from_env()
+    backend_up = None
+    if (args.platform or os.environ.get("JAX_PLATFORMS", "")) != "cpu" and (
+            os.environ.get("PALLAS_AXON_POOL_IPS")
+            or "axon" in os.environ.get("JAX_PLATFORMS", "")):
+        # a wedged TPU tunnel blocks jax.devices() indefinitely (observed: a
+        # trainer sat 20+ min in the lease poll with 4s of CPU time) — probe
+        # in a killable subprocess and fail loudly instead; the watchdog
+        # covers the probe-passes-then-lease-churns window during init
+        from ..utils.backend_probe import backend_watchdog, require_backend
+
+        try:
+            require_backend(attempts=2, probe_timeout=120)
+        except RuntimeError as e:
+            raise SystemExit(f"[trainer] TPU backend unreachable: {e} "
+                             "(pass --platform cpu to train on the host)")
+        backend_up = backend_watchdog(600)
     if args.multihost:
         jax.distributed.initialize()
     if args.world_size is not None or args.local_rank is not None:
@@ -353,12 +371,13 @@ def main(argv: Optional[Sequence[str]] = None) -> None:
     from ..train.plc_loop import PLCTrainer
     from ..utils.seeding import set_seed
 
-    cfg = config_from_args(args)
     set_seed(cfg.run.seed)
     if cfg.run.debug_nans:
         jax.config.update("jax_debug_nans", True)
     trainer_cls = PLCTrainer if cfg.workload == "plc" else Trainer
-    trainer = trainer_cls(cfg)
+    trainer = trainer_cls(cfg)  # builds the mesh: first real backend touch
+    if backend_up is not None:
+        backend_up()
     trainer.run()
 
 

@@ -1,0 +1,75 @@
+"""Measure PLC label-correction quality against ground truth.
+
+The digits export (`scripts/export_digits.py`) names every file by its
+global scikit-learn index (`img{i:04d}.png`), so the true label of each
+training image is recoverable even after noise injection wrote it under a
+wrong class directory. This script compares three label sets over the SAME
+dataset order the PLC trainer used (the deterministic imagefolder scan):
+
+  folder labels   — what the noisy export claims (what training started from)
+  corrected       — `<run>/plc_labels.npy` written by the PLC loop
+                    (train/plc_loop.py, FolderDataset.update_corrupted_label
+                    semantics — PLC/FolderDataset.py:80-82)
+  truth           — sklearn digits labels via the filename index
+
+and reports the noise rate before/after correction plus the fix/break
+counts — the quantified version of the reference's label-correction claim
+(PLC/utils.py:291-360).
+
+Usage: python scripts/plc_recovery.py --root /tmp/digits_noisy --run runs/digits_plc
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import re
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from ddp_classification_pytorch_tpu.data.imagefolder import scan_image_folder
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--root", required=True, help="noisy export root (train/ under it)")
+    ap.add_argument("--run", required=True, help="PLC run dir containing plc_labels.npy")
+    args = ap.parse_args()
+
+    from sklearn.datasets import load_digits
+
+    _, y = load_digits(return_X_y=True)
+
+    paths, folder_labels, _names = scan_image_folder(
+        os.path.join(args.root, "train"), imgs_per_class=0, max_classes=0)
+    folder_labels = np.asarray(folder_labels)
+    truth = np.array(
+        [y[int(re.search(r"img(\d+)\.png$", p).group(1))] for p in paths])
+
+    corrected = np.load(os.path.join(args.run, "plc_labels.npy"))
+    if corrected.shape != folder_labels.shape:
+        raise SystemExit(
+            f"label count mismatch: scan {folder_labels.shape} vs "
+            f"corrected {corrected.shape} — was the run trained on --root?")
+
+    n = len(truth)
+    noisy_before = folder_labels != truth
+    noisy_after = corrected != truth
+    changed = corrected != folder_labels
+    fixed = changed & noisy_before & ~noisy_after
+    broken = changed & ~noisy_before & noisy_after
+
+    print(f"samples                {n}")
+    print(f"noise before           {noisy_before.sum()}  ({noisy_before.mean():.1%})")
+    print(f"noise after            {noisy_after.sum()}  ({noisy_after.mean():.1%})")
+    print(f"labels changed         {changed.sum()}")
+    print(f"  correctly fixed      {fixed.sum()}")
+    print(f"  newly broken         {broken.sum()}")
+    print(f"  wrong->other-wrong   {(changed & noisy_before & noisy_after).sum()}")
+
+
+if __name__ == "__main__":
+    main()
