@@ -25,6 +25,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..utils.metrics import true_label_rank
+
 
 def gaussian_dist(mu: float, std: float, n: int) -> np.ndarray:
     """p_i ∝ exp(-((i-mu)/std)²), i = 1..n (NESTED/train.py:93-97)."""
@@ -84,14 +86,19 @@ def nested_all_k_counts(
         # within-block cumulative contributions: (B, G, C)
         contrib = fb[:, :, None] * wb[None, :, :]
         cum = carry_logits[:, None, :] + jnp.cumsum(contrib, axis=1)
-        # top-3 membership per K without full sort: count logits strictly
-        # greater than the true-class logit
+        # top-3 membership per K without full sort: ties count AGAINST the
+        # sample (utils/metrics.py::true_label_rank) — at small K a dead
+        # ReLU unit zeroes every logit, and tie-in-favor ranking scored the
+        # whole batch as top-1 hits (observed: val_top1 0.994 from a
+        # 0.21-train-top1 model), corrupting best-K selection. The finite
+        # guard closes the same hole for NaN logits (rank would read -1).
         true_logit = jnp.take_along_axis(
             cum, labels[:, None, None].astype(jnp.int32), axis=2
         )  # (B, G, 1)
-        rank = jnp.sum(cum > true_logit, axis=2)  # (B, G) number above true
-        top1 = jnp.sum((rank < 1) * row_w[:, None], axis=0)  # (G,)
-        top3 = jnp.sum((rank < 3) * row_w[:, None], axis=0)
+        rank = true_label_rank(cum, true_logit)  # (B, G)
+        ok = jnp.all(jnp.isfinite(cum), axis=2) * row_w[:, None]
+        top1 = jnp.sum((rank < 1) * ok, axis=0)  # (G,)
+        top3 = jnp.sum((rank < 3) * ok, axis=0)
         return cum[:, -1, :], (top1, top3)
 
     init = jnp.zeros((b, c), jnp.float32)

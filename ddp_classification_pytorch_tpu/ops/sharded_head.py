@@ -76,12 +76,11 @@ def arc_margin_ce_sharded(
     size; labels: (B,) int32. Returns replicated scalars
     (loss, top1_count, topk_count) over the GLOBAL batch — identical values
     to `CE(arc_margin_logits(...), labels)` + rank-count metrics, without a
-    (B, C) tensor on any device. One caveat: on EXACT logit ties at the
-    top-k boundary, the merge breaks ties by all-gather position (shard
-    order), which can differ from dense `lax.top_k`'s class-index order —
-    counts may then diverge from the dense metric by the tied entries
-    (measure-zero with real-valued features; asserted-identical tests use
-    untied logits).
+    (B, C) tensor on any device. Top-k counting is the same ties-against
+    rank formulation as the dense path (utils/metrics.py::true_label_rank):
+    per-shard `#{c : logit_c >= logit_true}` summed by one psum — cheaper
+    than a candidate all-gather merge and bit-identical to the dense metric
+    on every input, including exact ties and degenerate all-equal logits.
 
     `valid` (B,) 0/1 masks loader wrap-padding (eval): masked rows drop out
     of the loss numerator and the counts, and the loss denominator becomes
@@ -115,24 +114,20 @@ def arc_margin_ce_sharded(
         target = jax.lax.psum(jnp.sum(logits * one_hot, axis=1), class_axis)
         loss_sum = jnp.sum((lse - target) * valid)
 
-        # top-k: per-shard candidates (values + GLOBAL class ids), merged by
-        # a (B, k·mp) all-gather — k·mp scalars per row, not C
-        k = min(topk, c_local)
-        val, pos = jax.lax.top_k(logits, k)                      # (B, k)
-        cand_v = jax.lax.all_gather(val, class_axis, axis=1)     # (B, mp, k)
-        cand_i = jax.lax.all_gather(pos + offset, class_axis, axis=1)
-        cand_v = cand_v.reshape(val.shape[0], -1)
-        cand_i = cand_i.reshape(val.shape[0], -1)
-        _, sel = jax.lax.top_k(cand_v, topk)                     # (B, topk)
-        picked = jnp.take_along_axis(cand_i, sel, axis=1)
-        # rows with any non-finite logit count as misses — the dense metric
-        # path (utils/metrics.py::topk_hits) applies the same guard so a
-        # diverged model can't report healthy top-k next to a NaN loss
+        # top-k by global rank count: `target` is already the true-class
+        # logit (psum above), so rank = Σ_shards #{c : logit_c >= target} − 1
+        # — one (B,) psum instead of a (B, k·mp) candidate all-gather+merge,
+        # and exactly the dense ties-against convention
+        # (utils/metrics.py::true_label_rank). Rows with any non-finite
+        # logit count as misses, matching the dense NaN guard so a diverged
+        # model can't report healthy top-k next to a NaN loss.
+        rank = jax.lax.psum(
+            jnp.sum(logits >= target[:, None], axis=1), class_axis) - 1
         finite = (jax.lax.psum(
             jnp.sum(~jnp.isfinite(logits), axis=1), class_axis) == 0)
-        hits = (picked == labels[:, None]) * valid[:, None] * finite[:, None]
-        top1 = jnp.sum(hits[:, :1])
-        topn = jnp.sum(hits)
+        ok = valid * finite
+        top1 = jnp.sum((rank < 1) * ok)
+        topn = jnp.sum((rank < topk) * ok)
         n = jnp.sum(valid)
 
         if batch_axis is not None:

@@ -21,17 +21,30 @@ from typing import Sequence, Tuple
 import jax.numpy as jnp
 
 
+def true_label_rank(logits: jnp.ndarray, true_logit: jnp.ndarray) -> jnp.ndarray:
+    """#classes ranked at-or-above the true class, excluding the true class
+    itself — `>=` is exactly the union of `>` and `==` for floats, so one
+    compare+reduce covers both strict rank and the ties-against convention.
+    NaN compares all-False, giving rank -1: callers MUST pair this with a
+    finite guard (a diverged model would otherwise hit at every k)."""
+    return jnp.sum(logits >= true_logit, axis=-1) - 1
+
+
 def topk_hits(logits: jnp.ndarray, labels: jnp.ndarray, k: int) -> jnp.ndarray:
     """Per-sample bool: is the true label within the top-k logits?
 
-    Rank-count formulation — `rank = #{c : logit_c > logit_true}` — instead of
-    a full argsort: O(B·C) elementwise compare+reduce that XLA fuses into the
-    surrounding step, vs an O(B·C log C) sort per metric. Ties resolve in the
-    sample's favor (torch.topk tie-breaks by index; differences only matter
-    for exactly-equal logits, which don't occur in trained float models)."""
+    Rank-count formulation (`true_label_rank`) instead of a full argsort:
+    O(B·C) elementwise compare+reduce that XLA fuses into the surrounding
+    step, vs an O(B·C log C) sort per metric. Exact ties count AGAINST the
+    sample (the true class ranks below its peers): degenerate models DO emit
+    all-equal logits (a dead feature through a bias-free head zeroes every
+    class score — observed in the nested all-K sweep), and tie-in-favor
+    ranking scores such batches 100%. torch.topk instead tie-breaks by class
+    index; the conventions differ only on exactly-equal logits, where
+    pessimistic is the honest choice."""
     true_logit = jnp.take_along_axis(
         logits, labels[..., None].astype(jnp.int32), axis=-1)
-    rank = jnp.sum(logits > true_logit, axis=-1)
+    rank = true_label_rank(logits, true_logit)
     # NaN guard: comparisons with NaN are all False, which would make a
     # diverged model score rank 0 (= top-1 hit) on every sample; a row with
     # any non-finite logit is a miss (argsort semantics sorted NaNs last)

@@ -47,3 +47,17 @@ def test_average_meter():
     assert abs(m.avg - 2.0) < 1e-9
     m.reset()
     assert m.avg == 0.0
+
+
+def test_all_equal_logits_are_misses():
+    # dead model (e.g. zero features through a bias-free head): every class
+    # logit ties; tie-in-favor ranking would score 100% top-1 — ties must
+    # count against the sample
+    import jax.numpy as jnp
+
+    from ddp_classification_pytorch_tpu.utils.metrics import topk_hits
+
+    logits = jnp.zeros((6, 10))
+    labels = jnp.arange(6)
+    assert int(topk_hits(logits, labels, 1).sum()) == 0
+    assert int(topk_hits(logits, labels, 3).sum()) == 0

@@ -135,6 +135,29 @@ def test_nested_all_k_counts_matches_dense_path():
         assert int(t3[k]) == want3, k
 
 
+def test_nested_all_k_counts_ties_count_against():
+    # Dead units zero every logit at small K (all classes tie); tie-in-favor
+    # ranking scored the whole batch as top-1 hits there (observed live:
+    # val_top1 0.994 from a 0.21-train-top1 model), corrupting best-K
+    # selection. Ties must rank the true class below its peers.
+    import jax.numpy as jnp
+    rng = np.random.default_rng(6)
+    b, d, c = 8, 16, 5
+    f = np.zeros((b, d), np.float32)
+    f[:, 8:] = rng.normal(size=(b, 8))  # first 8 dims dead, rest alive
+    w = rng.normal(size=(c, d)).astype(np.float32)
+    labels = rng.integers(0, c, size=b)
+    t1, t3 = nested_all_k_counts(jnp.asarray(f), jnp.asarray(w),
+                                 jnp.asarray(labels), block=8)
+    # all-zero logits for K<=8: no hits at any k there
+    assert int(t1[:8].sum()) == 0 and int(t3[:8].sum()) == 0
+    # live dims beyond: still matches the dense argsort oracle
+    dense = np.asarray(nested_all_k_logits(jnp.asarray(f), jnp.asarray(w)))
+    for k in range(8, d):
+        order = np.argsort(-dense[k], axis=1, kind="stable")
+        assert int(t1[k]) == sum(labels[i] == order[i, 0] for i in range(b))
+
+
 def test_best_k_tiebreak_prefers_small_k():
     counts = jnp.asarray([5.0, 5.0, 5.0, 4.0])
     acc, k = best_k(counts, jnp.asarray(10.0))
