@@ -124,6 +124,11 @@ def build_parser() -> argparse.ArgumentParser:
     pl.add_argument("--delta_increment", type=float, default=-1.0, help="β step")
     pl.add_argument("--thd", type=float, default=-1.0, help="prob-correction confidence")
     pl.add_argument("--plc_warmup_epochs", type=int, default=-1)
+    pl.add_argument("--plc_max_flip_frac", type=float, default=-1.0,
+                    help="cap the label fraction one correction pass may "
+                         "flip, keeping the most-confident flips; guards "
+                         "against self-confirming collapse on an immature "
+                         "model (1.0 = uncapped reference semantics)")
 
     r = p.add_argument_group("run")
     r.add_argument("--seed", type=int, default=-1)
@@ -305,6 +310,8 @@ def config_from_args(args: argparse.Namespace) -> Config:
         cfg.plc.thd = args.thd
     if args.plc_warmup_epochs >= 0:
         cfg.plc.warmup_epochs = args.plc_warmup_epochs
+    if args.plc_max_flip_frac >= 0:
+        cfg.plc.max_flip_frac = args.plc_max_flip_frac
 
     if args.dp:
         cfg.parallel.data_axis = args.dp

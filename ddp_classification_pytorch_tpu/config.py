@@ -177,6 +177,13 @@ class PLCConfig:
     # synthetic-noise injection for experiments (utils.py:149-220); -1 = off
     noise_type: int = -1
     noise_factor: float = 1.2
+    # Safety valve over the reference behavior: cap the fraction of labels a
+    # single correction pass may flip, keeping the most-confident flips
+    # (largest prediction-vs-label disagreement). Correction on an immature
+    # model self-confirms: observed live, a warmup-5 run flipped 17% of
+    # labels in one pass and collapsed the label set onto 3 classes (noise
+    # 19% -> 82%). 1.0 = uncapped reference semantics.
+    max_flip_frac: float = 1.0
 
 
 @dataclass

@@ -115,6 +115,33 @@ def lrt_correction(
     return y, current_delta
 
 
+def cap_flips(
+    y: np.ndarray,
+    new_y: np.ndarray,
+    p: np.ndarray,
+    max_flip_frac: float,
+) -> np.ndarray:
+    """Cap one correction pass to `max_flip_frac` of the labels, keeping the
+    most-confident flips (largest p[new] − p[old] margin).
+
+    Safety valve over the reference semantics (no counterpart in
+    PLC/utils.py): correction on an immature model self-confirms — observed
+    live, an early pass flipped 17% of labels at once and collapsed the
+    label set onto 3 classes (noise 19% → 82%). `max_flip_frac=1.0` is the
+    uncapped reference behavior."""
+    y, new_y = np.asarray(y), np.asarray(new_y)
+    flips = np.nonzero(new_y != y)[0]
+    # round, don't truncate: 0.29*100 is 28.999999999999996 in floats
+    cap = int(round(max_flip_frac * len(y)))
+    if len(flips) <= cap:
+        return new_y
+    margin = p[flips, new_y[flips]] - p[flips, y[flips]]
+    keep = flips[np.argsort(-margin)[:cap]]
+    capped = y.copy()
+    capped[keep] = new_y[keep]
+    return capped
+
+
 def prob_correction(
     y_noise: np.ndarray,
     f_x: np.ndarray,

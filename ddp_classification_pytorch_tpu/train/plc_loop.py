@@ -29,7 +29,8 @@ import numpy as np
 
 from ..config import Config
 from ..data.loader import ShardedLoader
-from ..ops.labelnoise import label_noise, lrt_correction, prob_correction
+from ..ops.labelnoise import (cap_flips, label_noise, lrt_correction,
+                              prob_correction)
 from ..parallel import mesh as meshlib
 from ..utils.logging import EtaLogger, host0_print, is_host0
 from .loop import Trainer
@@ -141,11 +142,16 @@ class PLCTrainer(Trainer):
         """One correction pass; returns number of changed labels."""
         f_x = self.predict_train_logits()
         y = _dataset_labels(self.train_ds)
-        if self.cfg.plc.correction == "lrt":
-            # LRT operates on probability-like scores (utils.py:305-309)
+        cap_on = self.cfg.plc.max_flip_frac < 1.0
+        p = None
+        if self.cfg.plc.correction == "lrt" or cap_on:
+            # LRT (and the cap's confidence ranking) operate on
+            # probability-like scores (utils.py:305-309); skip the (N, C)
+            # softmax when neither needs it
             z = f_x - f_x.max(axis=1, keepdims=True)
             p = np.exp(z)
             p /= p.sum(axis=1, keepdims=True)
+        if self.cfg.plc.correction == "lrt":
             new_y, self.delta = lrt_correction(
                 y, p, self.delta, self.cfg.plc.delta_increment)
         elif self.cfg.plc.correction == "prob":
@@ -154,7 +160,15 @@ class PLCTrainer(Trainer):
                 self.delta, self.cfg.plc.delta_increment, self.cfg.plc.thd)
         else:
             raise ValueError(f"unknown correction {self.cfg.plc.correction!r}")
-        changed = int((new_y != y).sum())
+        changed = int((np.asarray(new_y) != y).sum())
+        if cap_on:
+            proposed = changed
+            new_y = cap_flips(y, new_y, p, self.cfg.plc.max_flip_frac)
+            changed = int((new_y != y).sum())
+            if changed < proposed:
+                host0_print(f"[plc] capped correction: {proposed} proposed "
+                            f"-> {changed} applied (max_flip_frac="
+                            f"{self.cfg.plc.max_flip_frac})")
         _set_dataset_labels(self.train_ds, new_y)
         return changed
 

@@ -106,3 +106,23 @@ def test_eta_approximation_learns_separable_features():
     # probe should mostly assign highest η to the true class
     acc = (eta.argmax(1) == labels).mean()
     assert acc > 0.9, acc
+
+
+def test_cap_flips_keeps_most_confident():
+    import numpy as np
+
+    from ddp_classification_pytorch_tpu.ops.labelnoise import cap_flips
+
+    y = np.array([0, 0, 0, 0, 1])
+    new = np.array([1, 2, 1, 0, 1])  # 3 proposed flips (rows 0,1,2)
+    p = np.array([
+        [0.4, 0.6, 0.0],   # margin 0.2
+        [0.1, 0.0, 0.9],   # margin 0.8  <- most confident
+        [0.45, 0.55, 0.0], # margin 0.1
+        [1.0, 0.0, 0.0],
+        [0.0, 1.0, 0.0],
+    ])
+    capped = cap_flips(y, new, p, max_flip_frac=2 / 5)
+    assert capped.tolist() == [1, 2, 0, 0, 1]  # rows 0,1 kept, row 2 reverted
+    # uncapped passes through untouched
+    assert cap_flips(y, new, p, 1.0).tolist() == new.tolist()
