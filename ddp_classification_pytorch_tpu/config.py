@@ -172,8 +172,13 @@ class PLCConfig:
     thd: float = 0.1  # prob_correction confidence threshold (:321)
     warmup_epochs: int = 2  # epochs of plain training before correction starts
     # collect f(x) with the prediction batch's own BN stats (as the reference
-    # harvests softmax during training, utils.py:269-271) vs running averages
-    batch_stat_predictions: bool = True
+    # harvests softmax during training, utils.py:269-271) vs running averages.
+    # Default False: the ordered correction scan is class-sorted, so each
+    # prediction batch is nearly single-class and batch statistics skew its
+    # normalization — measured 63% vs 99% argmax-vs-truth on a 97%-val model
+    # (train/plc_loop.py::_predict_pipeline); True reproduces the reference's
+    # harvest-during-training flavor and is only safe on shuffled batches
+    batch_stat_predictions: bool = False
     # synthetic-noise injection for experiments (utils.py:149-220); -1 = off
     noise_type: int = -1
     noise_factor: float = 1.2

@@ -46,6 +46,27 @@ from .state import create_train_state, param_count
 from .steps import make_eval_step, make_nested_eval_step, make_train_step
 
 
+def dataset_transform_preset(d) -> Optional[str]:
+    """Transform-preset name `build_datasets` uses for this DataConfig, or
+    None when the dataset kind has no image transform (synthetic). The single
+    source of truth for callers that rebuild a transform for an existing
+    dataset (e.g. the PLC eval-view prediction pipeline)."""
+    return {"imagefolder": d.transform, "plc": "clothing1m",
+            "cifar10": "cifar", "cifar100": "cifar"}.get(d.dataset)
+
+
+def make_native_batcher(ds, cfg: Config, train: bool) -> Optional[NativeBatcher]:
+    """NativeBatcher for `ds` iff the C++ dataplane applies to this config
+    (same eligibility the Trainer uses), else None."""
+    d = cfg.data
+    if (d.native_loader and d.dataset == "imagefolder"
+            and d.transform in NativeBatcher.SUPPORTED
+            and hasattr(ds, "paths") and NativeBatcher.available()):
+        return NativeBatcher(ds, d.transform, train, d.image_size,
+                             d.train_crop_size, cfg.run.seed, d.num_workers)
+    return None
+
+
 def build_datasets(cfg: Config) -> Tuple[Any, Any]:
     """(train_ds, val_ds) from DataConfig — the reference's per-silo dataset
     blocks (BASELINE/main.py:124-125, CDR/main.py:296, NESTED/train.py:342)."""
@@ -56,11 +77,14 @@ def build_datasets(cfg: Config) -> Tuple[Any, Any]:
         val = SyntheticDataset(max(size // 4, d.batch_size), d.image_size,
                                d.num_classes, seed=cfg.run.seed, item_offset=size)
         return train, val
+    preset = dataset_transform_preset(d)
+    if preset is None:
+        raise ValueError(f"unknown dataset {d.dataset!r}")
+    t_train = build_transform(preset, train=True, image_size=d.image_size,
+                              crop_size=d.train_crop_size)
+    t_val = build_transform(preset, train=False, image_size=d.image_size,
+                            crop_size=d.train_crop_size)
     if d.dataset == "imagefolder":
-        t_train = build_transform(d.transform, train=True, image_size=d.image_size,
-                                  crop_size=d.train_crop_size)
-        t_val = build_transform(d.transform, train=False, image_size=d.image_size,
-                                crop_size=d.train_crop_size)
         train = ImageFolderDataset.from_root(
             d.train_dir, t_train, d.imgs_per_class, d.max_classes)
         val = ImageFolderDataset.from_root(
@@ -69,8 +93,6 @@ def build_datasets(cfg: Config) -> Tuple[Any, Any]:
     if d.dataset in ("cifar10", "cifar100"):
         from ..data.cifar import CIFARDataset
 
-        t_train = build_transform("cifar", train=True, image_size=d.image_size)
-        t_val = build_transform("cifar", train=False, image_size=d.image_size)
         train = CIFARDataset(d.train_dir, True, t_train, kind=d.dataset)
         val = CIFARDataset(d.val_dir or d.train_dir, False, t_val, kind=d.dataset)
         if d.num_classes != train.num_classes:
@@ -85,10 +107,6 @@ def build_datasets(cfg: Config) -> Tuple[Any, Any]:
         # <root>/annotations with key-list + label files per split
         from ..data.plc import PLCDataset
 
-        t_train = build_transform("clothing1m", train=True, image_size=d.image_size,
-                                  crop_size=d.train_crop_size)
-        t_val = build_transform("clothing1m", train=False, image_size=d.image_size,
-                                crop_size=d.train_crop_size)
         train = PLCDataset.from_annotations(d.train_dir, "train", t_train,
                                             cls_size=d.imgs_per_class or 0)
         val = PLCDataset.from_annotations(d.val_dir or d.train_dir, "val", t_val)
@@ -127,14 +145,9 @@ class Trainer:
         else:
             self.mesh = meshlib.make_mesh(spec)
 
-        train_batcher = val_batcher = None
-        if (cfg.data.native_loader and cfg.data.dataset == "imagefolder"
-                and cfg.data.transform in NativeBatcher.SUPPORTED
-                and hasattr(train_ds, "paths") and NativeBatcher.available()):
-            mk = lambda ds, train: NativeBatcher(  # noqa: E731
-                ds, cfg.data.transform, train, cfg.data.image_size,
-                cfg.data.train_crop_size, cfg.run.seed, cfg.data.num_workers)
-            train_batcher, val_batcher = mk(train_ds, True), mk(val_ds, False)
+        train_batcher = make_native_batcher(train_ds, cfg, train=True)
+        val_batcher = make_native_batcher(val_ds, cfg, train=False)
+        if train_batcher is not None:
             host0_print("[trainer] native C++ dataplane active")
 
         self.train_loader = ShardedLoader(

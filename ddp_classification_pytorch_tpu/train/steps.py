@@ -253,8 +253,11 @@ def make_predict_step(
     batch_stat_mode=True normalizes with the prediction batch's own BN
     statistics (discarding the mutation) instead of the running averages —
     matching the reference's practice of harvesting softmax outputs during
-    training (PLC/utils.py:269-271), and robust when running stats are still
-    converging early in training."""
+    training (PLC/utils.py:269-271). Only safe on shuffled batches: on a
+    class-sorted scan each batch is nearly single-class and its statistics
+    skew normalization (measured 63% vs 99% argmax-vs-truth on a 97%-val
+    model — train/plc_loop.py::_predict_pipeline), which is why the PLC
+    correction pass defaults to running averages."""
     workload = cfg.model.head
 
     def step(state: TrainState, images: jnp.ndarray) -> jnp.ndarray:

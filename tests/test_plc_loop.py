@@ -130,6 +130,61 @@ def test_plc_auto_resume_restores_labels_and_delta(tmp_path):
     np.testing.assert_array_equal(np.asarray(tr2.train_ds.labels), labels_after)
 
 
+def _write_imagefolder(root, classes=2, per_class=8, size=32):
+    from PIL import Image
+
+    rng = np.random.default_rng(0)
+    for c in range(classes):
+        d = root / f"class{c}"
+        d.mkdir(parents=True)
+        for i in range(per_class):
+            arr = rng.integers(0, 255, (size, size, 3), np.uint8)
+            Image.fromarray(arr.astype(np.uint8)).save(d / f"img{i}.png")
+
+
+def test_predict_pipeline_is_eval_view_with_running_stats(tmp_path):
+    """Regression for the round-2 label-collapse bug: f(x) for correction
+    must come from the EVAL transform with running BN stats. Measured on a
+    97%-val model, the class-sorted scan made batch-stat predictions 63%
+    argmax-vs-truth (vs 99% running-stat) and collapsed 19% noise to 74%
+    (train/plc_loop.py::_predict_pipeline). Pin the whole contract by
+    equivalence: predict_train_logits() must equal a manual eval-mode
+    forward over the eval-transformed images in dataset order."""
+    _write_imagefolder(tmp_path / "train")
+    _write_imagefolder(tmp_path / "val")
+    cfg = _tiny_cfg(tmp_path / "out")
+    cfg.data.dataset = "imagefolder"
+    cfg.data.transform = "cifar"
+    cfg.data.train_dir = str(tmp_path / "train")
+    cfg.data.val_dir = str(tmp_path / "val")
+    cfg.data.num_classes = 2
+    cfg.data.batch_size = 8
+    tr = PLCTrainer(cfg)
+
+    assert cfg.plc.batch_stat_predictions is False  # running-stat default
+
+    predict_ds, _ = tr._predict_pipeline()
+    assert predict_ds is not tr.train_ds  # eval view, not the train dataset
+    # the eval view must be deterministic where the train pipeline is not
+    img_a = tr.train_ds.__getitem__(0, np.random.default_rng(1))[0]
+    img_b = tr.train_ds.__getitem__(0, np.random.default_rng(2))[0]
+    assert not np.array_equal(img_a, img_b)  # random crop/flip active
+    img_e1 = predict_ds.__getitem__(0, np.random.default_rng(1))[0]
+    img_e2 = predict_ds.__getitem__(0, np.random.default_rng(2))[0]
+    np.testing.assert_array_equal(img_e1, img_e2)
+
+    f_x = tr.predict_train_logits()
+    # manual oracle: eval-transformed images in scan order, eval-mode apply
+    # (train=False → running statistics). Any regression to the train
+    # transform OR to batch-stat normalization breaks this equivalence.
+    rng = np.random.default_rng(0)
+    imgs = np.stack([predict_ds.__getitem__(i, rng)[0]
+                     for i in range(len(predict_ds))])
+    variables = {"params": tr.state.params, "batch_stats": tr.state.batch_stats}
+    manual = tr.model.apply(variables, imgs, train=False)
+    np.testing.assert_allclose(f_x, np.asarray(manual), rtol=1e-4, atol=1e-4)
+
+
 def test_check_bad_images(tmp_path):
     """Corrupt files are reported by relative path; good ones are not
     (reference check_bad_image, PLC/FolderDataset.py:156-184)."""
