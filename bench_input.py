@@ -39,9 +39,33 @@ def ensure_dataset(root: str, n_images: int, src_size: int, classes: int = 8) ->
     file sizes)."""
     from PIL import Image
 
+    # the marker records the generation parameters: a re-run with different
+    # --images/--src-size must regenerate, not silently bench a stale set.
+    # Deletion is bounded to what this script provably created: exact
+    # class\d{3} dirs under a root IT stamped. An unstamped root that
+    # already holds class dirs (interrupted generation — or user data) is
+    # refused rather than cleaned, so nothing of the user's is ever at risk.
+    import re
+    import shutil
+
+    stamp = f"{n_images}x{src_size}x{classes}"
     done = os.path.join(root, ".complete")
+    own_dirs = [
+        os.path.join(root, e) for e in (os.listdir(root) if os.path.isdir(root) else [])
+        if re.fullmatch(r"class\d{3}", e)
+    ]
     if os.path.exists(done):
-        return
+        with open(done) as f:
+            if f.read().strip() == stamp:
+                return
+        for p in own_dirs:
+            shutil.rmtree(p)
+        os.remove(done)
+    elif own_dirs:
+        raise SystemExit(
+            f"{root} holds class dirs but no {done} marker (interrupted "
+            "generation, or a directory this script does not own) — delete "
+            "it or pass a fresh --root")
     rng = np.random.default_rng(0)
     per_class = n_images // classes
     for c in range(classes):
@@ -58,12 +82,14 @@ def ensure_dataset(root: str, n_images: int, src_size: int, classes: int = 8) ->
                 os.path.join(d, f"img{i:04d}.jpg"), quality=85
             )
     with open(done, "w") as f:
-        f.write("ok")
+        f.write(stamp)
 
 
 def bench_mode(ds, batcher, batch: int, workers: int, epochs: int) -> float:
     """images/sec through ShardedLoader over `epochs` full passes (first
     pass warms page cache + pools and is excluded)."""
+    if epochs < 1:
+        raise ValueError("bench needs --epochs >= 1 (one extra warm pass runs first)")
     from ddp_classification_pytorch_tpu.data import ShardedLoader
 
     loader = ShardedLoader(

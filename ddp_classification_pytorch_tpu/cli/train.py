@@ -381,10 +381,14 @@ def main(argv: Optional[Sequence[str]] = None) -> None:
     set_seed(cfg.run.seed)
     if cfg.run.debug_nans:
         jax.config.update("jax_debug_nans", True)
-    trainer_cls = PLCTrainer if cfg.workload == "plc" else Trainer
-    trainer = trainer_cls(cfg)  # builds the mesh: first real backend touch
     if backend_up is not None:
-        backend_up()
+        jax.devices()  # first real backend touch, bounded by the watchdog
+        backend_up()   # disarm BEFORE trainer construction: dataset scans /
+        # pretrained-checkpoint conversion are host work that can legitimately
+        # exceed the watchdog on reference-scale data, and the backend is
+        # already initialized at this point
+    trainer_cls = PLCTrainer if cfg.workload == "plc" else Trainer
+    trainer = trainer_cls(cfg)
     trainer.run()
 
 
