@@ -73,15 +73,17 @@ def _to_host(state: Any) -> Any:
            if isinstance(l, jax.Array) and not l.is_fully_addressable
            and not l.is_fully_replicated]
     if idx:
-        meshes = {id(leaves[i].sharding.mesh): leaves[i].sharding.mesh
-                  for i in idx}
+        # key by mesh EQUALITY, not identity: equal-but-distinct Mesh objects
+        # (a leaf re-put after restore with a freshly built identical mesh)
+        # gather correctly on either and must not fail the save
+        meshes = {leaves[i].sharding.mesh for i in idx}
         if len(meshes) > 1:
             # one jitted gather runs on one mesh; leaves from a second mesh
             # (state built across a re-mesh) would gather on the wrong one
             raise ValueError(
                 "checkpoint gather needs all sharded leaves on ONE mesh; "
                 f"found {len(meshes)}: "
-                + ", ".join(str(dict(m.shape)) for m in meshes.values())
+                + ", ".join(str(dict(m.shape)) for m in meshes)
                 + " — rebuild the train state on the current mesh first")
         mesh = leaves[idx[0]].sharding.mesh
         gathered = _replicated_gather(mesh)(tuple(leaves[i] for i in idx))

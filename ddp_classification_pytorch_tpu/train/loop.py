@@ -111,7 +111,8 @@ def build_datasets(cfg: Config) -> Tuple[Any, Any]:
                                             cls_size=d.imgs_per_class or 0)
         val = PLCDataset.from_annotations(d.val_dir or d.train_dir, "val", t_val)
         return train, val
-    raise ValueError(f"unknown dataset {d.dataset!r}")
+    raise RuntimeError(  # unreachable unless the preset map and the branches drift
+        f"dataset {d.dataset!r} has a transform preset but no build branch")
 
 
 def _profiling_unsupported() -> bool:

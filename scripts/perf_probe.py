@@ -103,7 +103,7 @@ def main() -> None:
         # a smoke-scale run instead (the numbers are only meaningful on TPU)
         print("# non-accelerator backend: downsizing to smoke scale",
               file=sys.stderr)
-        args.batch, args.image_size = min(args.batch, 16), 64
+        args.batch, args.image_size = min(args.batch, 16), min(args.image_size, 64)
         args.steps, args.sweep = min(args.steps, 3), ""
     mesh = meshlib.make_mesh(devices=devices)
 

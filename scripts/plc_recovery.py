@@ -46,8 +46,14 @@ def main() -> None:
     paths, folder_labels, _names = scan_image_folder(
         os.path.join(args.root, "train"), imgs_per_class=0, max_classes=0)
     folder_labels = np.asarray(folder_labels)
-    truth = np.array(
-        [y[int(re.search(r"img(\d+)\.png$", p).group(1))] for p in paths])
+    matches = [re.search(r"img(\d+)\.png$", p) for p in paths]
+    bad = [p for p, m in zip(paths, matches) if m is None]
+    if bad:
+        raise SystemExit(
+            f"{len(bad)} files do not look like an export_digits.py export "
+            f"(first: {bad[0]}) — truth labels are only recoverable from "
+            "img{i}.png filenames")
+    truth = np.array([y[int(m.group(1))] for m in matches])
 
     corrected = np.load(os.path.join(args.run, "plc_labels.npy"))
     if corrected.shape != folder_labels.shape:
