@@ -129,6 +129,12 @@ def build_parser() -> argparse.ArgumentParser:
                          "flip, keeping the most-confident flips; guards "
                          "against self-confirming collapse on an immature "
                          "model (1.0 = uncapped reference semantics)")
+    pl.add_argument("--plc_batch_stat_predictions", action="store_true",
+                    help="harvest correction f(x) with each batch's own BN "
+                         "statistics (the reference's during-training "
+                         "flavor, PLC/utils.py:269-271); UNSAFE on the "
+                         "default class-sorted scan — measured 63%% vs 99%% "
+                         "prediction accuracy vs the running-stat default")
 
     r = p.add_argument_group("run")
     r.add_argument("--seed", type=int, default=-1)
@@ -312,6 +318,8 @@ def config_from_args(args: argparse.Namespace) -> Config:
         cfg.plc.warmup_epochs = args.plc_warmup_epochs
     if args.plc_max_flip_frac >= 0:
         cfg.plc.max_flip_frac = args.plc_max_flip_frac
+    if args.plc_batch_stat_predictions:
+        cfg.plc.batch_stat_predictions = True
 
     if args.dp:
         cfg.parallel.data_axis = args.dp

@@ -24,6 +24,11 @@ def test_transform_default_follows_workload_preset():
     assert _cfg("cdr").data.transform == "cdr"
 
 
+def test_plc_batch_stat_predictions_flag():
+    assert _cfg("plc").plc.batch_stat_predictions is False  # safe default
+    assert _cfg("plc", "--plc_batch_stat_predictions").plc.batch_stat_predictions
+
+
 def test_live_clip_schedule_flag_disables_dead_schedule():
     cfg = _cfg("cdr", "--live_clip_schedule")
     assert cfg.optim.cdr_dead_schedule is False
