@@ -117,9 +117,15 @@ def build_datasets(cfg: Config) -> Tuple[Any, Any]:
 
 def _profiling_unsupported() -> bool:
     """jax.profiler.start_trace wedges tunneled TPU plugins (observed: the
-    whole PJRT client hangs until the lease expires). Gate it off there."""
+    whole PJRT client hangs until the lease expires). Gate it off there —
+    but only there: a CPU backend profiles fine even when the tunnel env
+    vars are present (the relay is not in the path). Callers run after the
+    backend is initialized (the Trainer builds its mesh first), so
+    default_backend() does not trigger a fresh init here."""
     import os
 
+    if jax.default_backend() == "cpu":
+        return False
     return bool(os.environ.get("PALLAS_AXON_POOL_IPS")) or (
         os.environ.get("JAX_PLATFORMS", "") == "axon")
 

@@ -101,6 +101,26 @@ def test_cdr_e2e_smoke(tmp_path):
     assert np.isfinite(m["loss"])
 
 
+def test_profiler_window_captures_trace(tmp_path):
+    """--profile_steps on a non-tunneled backend (CPU here) captures a real
+    jax.profiler trace into <out>/profile and deactivates cleanly — the
+    SURVEY §5 tracing subsystem, untestable on the tunneled chip where the
+    Trainer auto-gates it off."""
+    import os
+
+    cfg = tiny_cfg("baseline", epochs=1)
+    cfg.run.out_dir = str(tmp_path)
+    cfg.run.profile_steps = 2
+    tr = Trainer(cfg)
+    tr.run()
+    assert tr._prof_active is False
+    assert tr._prof_steps == 0  # window closed inside epoch 0
+    prof_dir = str(tmp_path / "profile")
+    trace_files = [os.path.join(r, f) for r, _, fs in os.walk(prof_dir) for f in fs]
+    assert any(f.endswith((".trace.json.gz", ".xplane.pb")) for f in trace_files), (
+        f"no trace artifacts under {prof_dir}: {trace_files}")
+
+
 def test_checkpoint_save_and_resume(tmp_path):
     cfg = tiny_cfg("baseline", epochs=1)
     cfg.data.synthetic_size = 64
