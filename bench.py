@@ -37,6 +37,33 @@ import time
 
 A100_RESNET50_IMG_PER_SEC = 2500.0
 
+# The most recent live capture committed to the repo (docs/performance.md,
+# "Committed live capture" section — v5e via the axon tunnel, 2026-07-31).
+# Emitted under "last_known_good" when the backend is unreachable so an
+# outage window still produces a self-explaining artifact instead of a
+# bare rc=3 (VERDICT r2 weak #7).
+LAST_KNOWN_GOOD = {
+    "captured": "2026-07-31",
+    "source": "docs/performance.md (builder-captured live run, rc=0, 402s)",
+    "metric": "resnet50_train_images_per_sec_per_chip",
+    "value": 2672.07,
+    "unit": "images/sec/chip",
+    "step_ms": 47.9,
+    "mfu": 0.3243,
+    "vs_baseline": 1.0688,
+    "extra": [
+        {"metric": "arcface_resnet50_train_images_per_sec_per_chip",
+         "value": 2542.49, "unit": "images/sec/chip", "step_ms": 50.34,
+         "mfu": 0.3086},
+        {"metric": "vit_s16_flash_train_images_per_sec_per_chip",
+         "value": 1892.05, "unit": "images/sec/chip", "step_ms": 67.65,
+         "mfu": 0.2443,
+         "note": "captured with the flash kernel forced (pre-auto-pick); "
+                 "the current bench emits vit_s16_dense_auto at 224px "
+                 "(196 tokens < flash_min_tokens)"},
+    ],
+}
+
 # Per-chip dense bf16 peak FLOP/s by device_kind substring (public specs).
 # Matched longest-prefix-first so "TPU v5 lite" does not hit "TPU v5".
 _PEAK_BF16 = (
@@ -172,6 +199,12 @@ def main() -> None:
         require_backend(attempts=2, probe_timeout=120)
     except RuntimeError as e:
         print(f"# {e}", file=sys.stderr)
+        # Self-explaining outage artifact: one JSON line that says the
+        # backend was down AND carries the last committed live capture, so
+        # the driver's BENCH_r0N.json is never an opaque rc=3.
+        print(json.dumps({"backend": "unreachable",
+                          "error": str(e),
+                          "last_known_good": LAST_KNOWN_GOOD}), flush=True)
         sys.exit(3)
     backend_up = backend_watchdog(600)
 
@@ -253,12 +286,17 @@ def main() -> None:
             elif name == "vit":
                 c = get_preset("baseline")
                 c.model.arch = "vit_s16"
+                # auto-pick: flash kernel at/above flash_min_tokens, XLA
+                # fused dense below (196 tokens at 224px → dense, the
+                # equal-or-better path there; docs/performance.md knob #4)
                 c.model.flash_attention = True
                 c.model.dtype = cfg.model.dtype
                 c.data.num_classes = 1000
                 c.data.image_size = cfg.data.image_size
                 c.data.batch_size = (128 if on_accel else 8) * n_chips
-                label = "vit_s16_flash"
+                tokens = (c.data.image_size // 16) ** 2
+                label = ("vit_s16_flash" if tokens >= c.model.flash_min_tokens
+                         else "vit_s16_dense_auto")
                 row_mesh = mesh
             else:
                 print(f"# unknown extra row {name!r}", file=sys.stderr)
