@@ -154,7 +154,8 @@ class Trainer:
 
         train_batcher = make_native_batcher(train_ds, cfg, train=True)
         val_batcher = make_native_batcher(val_ds, cfg, train=False)
-        if train_batcher is not None:
+        self.native_dataplane = train_batcher is not None
+        if self.native_dataplane:
             host0_print("[trainer] native C++ dataplane active")
 
         self.train_loader = ShardedLoader(
@@ -208,6 +209,13 @@ class Trainer:
             if self.start_epoch:
                 host0_print(
                     f"auto-resumed from {cfg.run.out_dir} at epoch {self.start_epoch}")
+        if self.start_epoch and self.records is not None:
+            # keep the pre-preemption curve: reload history.json truncated to
+            # the restored epoch so the resumed run appends, not overwrites
+            self.records.resume_at(self.start_epoch)
+        if self.records is not None and self.native_dataplane:
+            # the committed record itself proves which input path fed the run
+            self.records.append_txt("# native C++ dataplane active")
 
         host0_print(
             f"[trainer] workload={cfg.workload} arch={cfg.model.arch} "

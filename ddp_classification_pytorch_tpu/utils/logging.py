@@ -129,17 +129,57 @@ class RecordWriter:
         with open(self.txt_path, "a") as f:
             f.write(line.rstrip("\n") + "\n")
 
+    def resume_at(self, start_epoch: int) -> None:
+        """Reload an existing history.json and truncate it to `start_epoch`
+        so a resumed run APPENDS to the pre-preemption curve instead of
+        rewriting history.json with only post-resume epochs (observed:
+        runs/digits_plc_fixed/history.json carried epochs 16-24 while
+        output.txt had all 25). Truncation keeps history consistent with
+        the checkpoint actually restored."""
+        if not is_host0():
+            return
+        if os.path.exists(self.history_path):
+            try:
+                with open(self.history_path) as f:
+                    prior = json.load(f)
+            except (json.JSONDecodeError, OSError):
+                prior = {}  # a torn write must not kill the resumed run
+            for k, v in prior.items():
+                if isinstance(v, list):
+                    self.history[k] = [
+                        float(x) if x is not None else None
+                        for x in v[:start_epoch]
+                    ]
+            self.flush_history()
+
     def log_epoch(self, epoch: int, **metrics: float) -> None:
-        """One epoch record → both output.txt and the in-memory history."""
+        """One epoch record → both output.txt and the in-memory history.
+
+        The invariant is `history[k][e] == epoch e's value`: lists shorter
+        than `epoch` (a resume whose prior history was torn or had already
+        lost its head) are padded with JSON nulls so the curve never shifts
+        — epoch 16's loss must not masquerade as epoch 0's."""
         self.append_txt(
             f"epoch:{epoch}\t" + "\t".join(f"{k}:{v:.6f}" for k, v in metrics.items())
         )
         for k, v in metrics.items():
-            self.history.setdefault(k, []).append(float(v))
+            lst = self.history.setdefault(k, [])
+            if len(lst) > epoch:
+                lst[epoch] = float(v)  # re-logged epoch overwrites in place
+            else:
+                while len(lst) < epoch:
+                    lst.append(None)
+                lst.append(float(v))
         self.flush_history()
 
     def flush_history(self) -> None:
         if not is_host0():
             return
-        with open(self.history_path, "w") as f:
+        # atomic tmp+replace (same pattern as train/checkpoint.py): a
+        # preemption mid-write must leave the previous epoch's complete file,
+        # not a torn one — resume_at treats a torn file as empty, which
+        # would drop the whole pre-preemption curve
+        tmp = self.history_path + ".tmp"
+        with open(tmp, "w") as f:
             json.dump(self.history, f, indent=1)
+        os.replace(tmp, self.history_path)

@@ -1,0 +1,80 @@
+"""RecordWriter resume semantics.
+
+A resumed run must APPEND to the pre-preemption history curve: round 2
+shipped a run (runs/digits_plc_fixed) whose history.json covered only
+epochs 16-24 because the writer started empty and overwrote the file.
+`resume_at` reloads and truncates to the restored epoch.
+"""
+
+import json
+import os
+
+from ddp_classification_pytorch_tpu.utils.logging import RecordWriter
+
+
+def _write_history(out_dir, n):
+    os.makedirs(out_dir, exist_ok=True)
+    with open(os.path.join(out_dir, "history.json"), "w") as f:
+        json.dump({"loss": [float(10 - e) for e in range(n)],
+                   "val_top1": [float(e) for e in range(n)]}, f)
+
+
+def test_resume_appends_to_prior_history(tmp_path):
+    out = str(tmp_path / "run")
+    _write_history(out, 5)
+
+    w = RecordWriter(out)
+    w.resume_at(3)  # checkpoint restored at epoch 3 → epochs 3,4 are stale
+    assert w.history["loss"] == [10.0, 9.0, 8.0]
+
+    w.log_epoch(3, loss=7.5, val_top1=3.5)
+    w.log_epoch(4, loss=7.0, val_top1=4.5)
+    with open(os.path.join(out, "history.json")) as f:
+        hist = json.load(f)
+    assert hist["loss"] == [10.0, 9.0, 8.0, 7.5, 7.0]
+    assert hist["val_top1"] == [0.0, 1.0, 2.0, 3.5, 4.5]
+
+
+def test_resume_without_prior_history_is_noop(tmp_path):
+    w = RecordWriter(str(tmp_path / "fresh"))
+    w.resume_at(4)
+    assert w.history == {}
+
+
+def test_resume_with_torn_history_survives(tmp_path):
+    """A torn prior file must not raise, and the resumed epochs must land
+    at their TRUE indices (nulls mark the lost head) — epoch 1's value
+    masquerading as epoch 0's would corrupt every downstream curve."""
+    out = str(tmp_path / "run")
+    os.makedirs(out, exist_ok=True)
+    with open(os.path.join(out, "history.json"), "w") as f:
+        f.write('{"loss": [1.0, ')  # torn write mid-dump
+    w = RecordWriter(out)
+    w.resume_at(1)  # must not raise
+    w.log_epoch(1, loss=0.5)
+    with open(os.path.join(out, "history.json")) as f:
+        assert json.load(f)["loss"] == [None, 0.5]
+
+
+def test_resume_with_short_history_pads_to_true_epoch(tmp_path):
+    """Prior history that already lost its head (the runs/digits_plc_fixed
+    damage shape: epochs 16-24 stored at indices 0-8) must not be re-labeled
+    as epochs 0..N — lists shorter than the resume epoch keep their entries
+    and the new epochs land at their true indices behind null padding."""
+    out = str(tmp_path / "run")
+    _write_history(out, 2)  # only epochs 0-1 survive on disk
+    w = RecordWriter(out)
+    w.resume_at(5)
+    w.log_epoch(5, loss=0.25, val_top1=5.5)
+    with open(os.path.join(out, "history.json")) as f:
+        hist = json.load(f)
+    assert hist["loss"] == [10.0, 9.0, None, None, None, 0.25]
+    assert hist["val_top1"] == [0.0, 1.0, None, None, None, 5.5]
+
+
+def test_relogged_epoch_overwrites_in_place(tmp_path):
+    w = RecordWriter(str(tmp_path / "run"))
+    w.log_epoch(0, loss=1.0)
+    w.log_epoch(1, loss=0.8)
+    w.log_epoch(1, loss=0.7)  # e.g. a re-run epoch after a partial resume
+    assert w.history["loss"] == [1.0, 0.7]
