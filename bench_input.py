@@ -42,9 +42,12 @@ def ensure_dataset(root: str, n_images: int, src_size: int, classes: int = 8) ->
     # the marker records the generation parameters: a re-run with different
     # --images/--src-size must regenerate, not silently bench a stale set.
     # Deletion is bounded to what this script provably created: exact
-    # class\d{3} dirs under a root IT stamped. An unstamped root that
-    # already holds class dirs (interrupted generation — or user data) is
-    # refused rather than cleaned, so nothing of the user's is ever at risk.
+    # class\d{3,} dirs under a root IT stamped (\d{3,} not \d{3}: {c:03d}
+    # widens past three digits at c >= 1000, and cleanup must match every
+    # width generation can produce or stale dirs would mix into the new
+    # set). An unstamped root that already holds class dirs (interrupted
+    # generation — or user data) is refused rather than cleaned, so nothing
+    # of the user's is ever at risk.
     import re
     import shutil
 
@@ -52,7 +55,7 @@ def ensure_dataset(root: str, n_images: int, src_size: int, classes: int = 8) ->
     done = os.path.join(root, ".complete")
     own_dirs = [
         os.path.join(root, e) for e in (os.listdir(root) if os.path.isdir(root) else [])
-        if re.fullmatch(r"class\d{3}", e)
+        if re.fullmatch(r"class\d{3,}", e)
     ]
     if os.path.exists(done):
         with open(done) as f:
@@ -123,6 +126,10 @@ def main() -> None:
     ap.add_argument("--chip-rate", type=float, default=2550.0,
                     help="chip consumption rate to compare against "
                          "(flagship bench.py images/sec/chip)")
+    ap.add_argument("--scaling", default="",
+                    help="comma list of worker counts (e.g. 1,2,4): measure "
+                         "throughput at each and print a scaling curve — the "
+                         "evidence behind any cores×N headroom extrapolation")
     args = ap.parse_args()
     workers = args.workers or (os.cpu_count() or 4)
 
@@ -135,6 +142,32 @@ def main() -> None:
     ensure_dataset(args.root, args.images, args.src_size)
     tf = build_transform("baseline", train=True, image_size=args.image_size)
     ds = ImageFolderDataset.from_root(args.root, tf)
+
+    if args.scaling:
+        # Worker-scaling curve: same dataset, same pass count, one point per
+        # worker count — the measured slope behind (or against) any
+        # "× cores" headroom extrapolation. On a 1-core host the curve goes
+        # flat immediately; that flatness is itself the honest datum.
+        counts = [int(w) for w in args.scaling.split(",") if w]
+        for mode in (["native"] if NativeBatcher.available() else []) + ["python"]:
+            points = []
+            for w in counts:
+                if mode == "native":
+                    b = NativeBatcher(ds, "baseline", train=True,
+                                      image_size=args.image_size,
+                                      crop_size=tf.out_size, seed=0,
+                                      num_threads=w)
+                else:
+                    b = None
+                points.append(round(bench_mode(ds, b, args.batch, w, args.epochs), 1))
+            print(json.dumps({
+                "metric": f"input_{mode}_scaling_images_per_sec",
+                "workers": counts,
+                "values": points,
+                "host_cpu_count": os.cpu_count(),
+                "unit": "images/sec/host per worker count",
+            }))
+        return
 
     rates = {}
     if NativeBatcher.available():
