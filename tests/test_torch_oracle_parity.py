@@ -1,0 +1,92 @@
+"""Full-model torch-vs-flax forward parity through the weight converter.
+
+The strongest available proxy for "pretrained torchvision checkpoints load
+correctly" in a zero-egress sandbox (VERDICT r2 missing #2): build the
+torchvision architecture in torch (tests/torch_resnet_oracle.py), randomize
+every parameter and buffer, push its real `state_dict()` through
+`convert_resnet_state_dict` + `merge_into_variables`, and require the flax
+model to reproduce the torch forward end to end in f32 — stride-2 paths,
+downsample branches, BN eval statistics, pooling and the fc head included.
+Any drift in layer mapping, transpose convention, padding choice, or BN
+epsilon fails these tests.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from ddp_classification_pytorch_tpu.models import resnet as R
+from ddp_classification_pytorch_tpu.models.import_torch import (
+    convert_resnet_state_dict,
+    merge_into_variables,
+)
+
+torch = pytest.importorskip("torch")
+
+from torch_resnet_oracle import make_torch_resnet, randomize_  # noqa: E402
+
+
+def _forward_pair(arch: str, num_classes: int, image_size: int, seed: int):
+    tmodel = make_torch_resnet(arch, num_classes)
+    randomize_(tmodel, seed=seed)
+    tmodel.eval()
+
+    rng = np.random.default_rng(seed + 100)
+    x = rng.normal(size=(2, 3, image_size, image_size)).astype(np.float32)
+    with torch.no_grad():
+        ref = tmodel(torch.from_numpy(x)).numpy()
+
+    fmodel = getattr(R, arch)(num_classes=num_classes, dtype=jnp.float32)
+    variables = fmodel.init(jax.random.PRNGKey(0),
+                            jnp.zeros((1, image_size, image_size, 3)),
+                            train=False)
+    converted = convert_resnet_state_dict(tmodel.state_dict())
+    merged = merge_into_variables(variables, converted)
+    out = fmodel.apply(merged, jnp.asarray(x.transpose(0, 2, 3, 1)),
+                       train=False)
+    return np.asarray(out), ref
+
+
+@pytest.mark.parametrize("arch,image_size", [
+    ("resnet18", 64),   # BasicBlock path, every stride-2 stage transition
+    ("resnet50", 64),   # Bottleneck path incl. the stride-1 layer1 downsample
+])
+def test_full_model_forward_matches_torch(arch, image_size):
+    got, ref = _forward_pair(arch, num_classes=37, image_size=image_size,
+                             seed={"resnet18": 0, "resnet50": 1}[arch])
+    np.testing.assert_allclose(got, ref, rtol=2e-4, atol=2e-4)
+    # logits must be non-degenerate for the comparison to mean anything
+    assert np.std(ref) > 1e-3
+
+
+def test_full_model_forward_matches_torch_odd_input():
+    """Odd spatial size exercises the asymmetric-padding trap: SAME padding
+    would shift the stride-2 grids; the explicit k//2 padding must not."""
+    got, ref = _forward_pair("resnet18", num_classes=11, image_size=75, seed=2)
+    np.testing.assert_allclose(got, ref, rtol=2e-4, atol=2e-4)
+
+
+def test_feature_extractor_matches_torch_prepool():
+    """num_classes=0 (the NESTED NetFeat role) must equal the torch pooled
+    feature — proves the backbone alone, independent of the fc mapping."""
+    tmodel = make_torch_resnet("resnet18", 5)
+    randomize_(tmodel, seed=3)
+    tmodel.eval()
+    rng = np.random.default_rng(103)
+    x = rng.normal(size=(2, 3, 64, 64)).astype(np.float32)
+    with torch.no_grad():
+        h = torch.relu(tmodel.bn1(tmodel.conv1(torch.from_numpy(x))))
+        h = tmodel.maxpool(h)
+        h = tmodel.layer4(tmodel.layer3(tmodel.layer2(tmodel.layer1(h))))
+        ref = h.mean(dim=(2, 3)).numpy()
+
+    fmodel = R.resnet18(num_classes=0, dtype=jnp.float32)
+    variables = fmodel.init(jax.random.PRNGKey(0), jnp.zeros((1, 64, 64, 3)),
+                            train=False)
+    converted = convert_resnet_state_dict(tmodel.state_dict(), include_fc=False)
+    merged = merge_into_variables(variables, converted)
+    got = fmodel.apply(merged, jnp.asarray(x.transpose(0, 2, 3, 1)),
+                       train=False)
+    np.testing.assert_allclose(np.asarray(got), ref, rtol=2e-4, atol=2e-4)

@@ -1,0 +1,142 @@
+"""Test-only torch ResNet oracle.
+
+A from-scratch torch implementation of the standard torchvision ResNet
+topology (v1.5: stride on the Bottleneck's 3x3 conv) with torchvision's
+parameter naming (`conv1`, `bn1`, `layer1.0.conv1`, `downsample.0/1`,
+`fc`), so its `state_dict()` is exactly the format
+`models/import_torch.convert_resnet_state_dict` consumes.
+
+Why it exists: the reference defaults every trainer to pretrained
+torchvision weights (BASELINE/main.py:135, CDR/main.py:330,
+NESTED/model/imagenet_resnet.py:195-203), but torchvision itself is not
+installed in this sandbox and egress is zero — so the only way to prove
+the import path end-to-end is to build the same architecture in torch
+(which IS installed), randomize it, and assert full-model forward
+equality through the converter. This file re-types the public
+architecture from its published definition; it is not a copy of the
+reference's `NESTED/model/imagenet_resnet.py` (that file carries extra
+vestigial buffers and a custom forward this oracle deliberately omits).
+"""
+
+from __future__ import annotations
+
+import torch
+import torch.nn as nn
+
+
+class BasicBlock(nn.Module):
+    expansion = 1
+
+    def __init__(self, inplanes: int, planes: int, stride: int = 1,
+                 downsample: nn.Module | None = None):
+        super().__init__()
+        self.conv1 = nn.Conv2d(inplanes, planes, 3, stride, 1, bias=False)
+        self.bn1 = nn.BatchNorm2d(planes)
+        self.conv2 = nn.Conv2d(planes, planes, 3, 1, 1, bias=False)
+        self.bn2 = nn.BatchNorm2d(planes)
+        self.downsample = downsample
+
+    def forward(self, x):
+        identity = x
+        out = torch.relu(self.bn1(self.conv1(x)))
+        out = self.bn2(self.conv2(out))
+        if self.downsample is not None:
+            identity = self.downsample(x)
+        return torch.relu(out + identity)
+
+
+class Bottleneck(nn.Module):
+    expansion = 4
+
+    def __init__(self, inplanes: int, planes: int, stride: int = 1,
+                 downsample: nn.Module | None = None):
+        super().__init__()
+        self.conv1 = nn.Conv2d(inplanes, planes, 1, bias=False)
+        self.bn1 = nn.BatchNorm2d(planes)
+        # v1.5: the stride lives on the 3x3, matching models/resnet.py
+        self.conv2 = nn.Conv2d(planes, planes, 3, stride, 1, bias=False)
+        self.bn2 = nn.BatchNorm2d(planes)
+        self.conv3 = nn.Conv2d(planes, planes * self.expansion, 1, bias=False)
+        self.bn3 = nn.BatchNorm2d(planes * self.expansion)
+        self.downsample = downsample
+
+    def forward(self, x):
+        identity = x
+        out = torch.relu(self.bn1(self.conv1(x)))
+        out = torch.relu(self.bn2(self.conv2(out)))
+        out = self.bn3(self.conv3(out))
+        if self.downsample is not None:
+            identity = self.downsample(x)
+        return torch.relu(out + identity)
+
+
+class TorchResNet(nn.Module):
+    def __init__(self, block, layers, num_classes: int = 1000):
+        super().__init__()
+        self.inplanes = 64
+        self.conv1 = nn.Conv2d(3, 64, 7, 2, 3, bias=False)
+        self.bn1 = nn.BatchNorm2d(64)
+        self.maxpool = nn.MaxPool2d(3, 2, 1)
+        self.layer1 = self._make_layer(block, 64, layers[0])
+        self.layer2 = self._make_layer(block, 128, layers[1], stride=2)
+        self.layer3 = self._make_layer(block, 256, layers[2], stride=2)
+        self.layer4 = self._make_layer(block, 512, layers[3], stride=2)
+        self.fc = nn.Linear(512 * block.expansion, num_classes)
+
+    def _make_layer(self, block, planes: int, blocks: int, stride: int = 1):
+        downsample = None
+        if stride != 1 or self.inplanes != planes * block.expansion:
+            downsample = nn.Sequential(
+                nn.Conv2d(self.inplanes, planes * block.expansion, 1, stride,
+                          bias=False),
+                nn.BatchNorm2d(planes * block.expansion),
+            )
+        layers = [block(self.inplanes, planes, stride, downsample)]
+        self.inplanes = planes * block.expansion
+        for _ in range(1, blocks):
+            layers.append(block(self.inplanes, planes))
+        return nn.Sequential(*layers)
+
+    def forward(self, x):
+        x = torch.relu(self.bn1(self.conv1(x)))
+        x = self.maxpool(x)
+        x = self.layer4(self.layer3(self.layer2(self.layer1(x))))
+        x = x.mean(dim=(2, 3))  # adaptive avg pool to 1x1, flattened
+        return self.fc(x)
+
+
+_DEPTHS = {
+    "resnet18": (BasicBlock, [2, 2, 2, 2]),
+    "resnet34": (BasicBlock, [3, 4, 6, 3]),
+    "resnet50": (Bottleneck, [3, 4, 6, 3]),
+}
+
+
+def make_torch_resnet(arch: str, num_classes: int = 1000) -> TorchResNet:
+    block, layers = _DEPTHS[arch]
+    return TorchResNet(block, layers, num_classes)
+
+
+def randomize_(model: TorchResNet, seed: int = 0) -> None:
+    """Randomize every parameter AND buffer so the parity check can catch
+    any mapping swap. Torch's defaults would mask whole bug classes:
+    running_mean=0/var=1 hides a mean<->var swap, BN weight=1/bias=0 hides
+    a scale<->bias swap."""
+    gen = torch.Generator().manual_seed(seed)
+    with torch.no_grad():
+        for name, p in model.named_parameters():
+            if p.ndim >= 2:  # conv / linear weights, fan-in scaled so
+                # activations stay O(1) — unscaled noise compounds to ~1e6
+                # by layer4 and f32 accumulation noise then swamps tight
+                # tolerances
+                fan_in = p.numel() // p.shape[0]
+                p.normal_(0.0, fan_in ** -0.5, generator=gen)
+            elif "weight" in name:  # BN scale
+                p.uniform_(0.5, 1.5, generator=gen)
+            else:  # biases
+                p.normal_(0.0, 0.1, generator=gen)
+        for name, b in model.named_buffers():
+            if name.endswith("running_mean"):
+                b.normal_(0.0, 0.2, generator=gen)
+            elif name.endswith("running_var"):
+                b.uniform_(0.5, 2.0, generator=gen)
