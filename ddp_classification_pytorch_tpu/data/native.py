@@ -2,11 +2,12 @@
 
 The reference feeds GPUs with torch DataLoader worker *processes* running
 PIL/torchvision per sample (BASELINE/main.py:130-131). Here the host hot path
-is one C call per batch (`native/dataplane.cpp`): libjpeg decode →
-torchvision-semantics RandomResizedCrop / resize+center-crop → flip →
-normalize, fanned over a thread pool in native code (no GIL, no per-sample
-Python). Falls back to the pure-Python pipeline automatically when the
-library can't be built or a file isn't a JPEG.
+is one C call per batch (`native/dataplane.cpp`): libjpeg/libpng decode
+(dispatch on magic bytes) → torchvision-semantics RandomResizedCrop /
+resize+center-crop → flip → normalize, fanned over a thread pool in native
+code (no GIL, no per-sample Python). Falls back to the pure-Python pipeline
+automatically when the library can't be built or a file is an unsupported
+format.
 """
 
 from __future__ import annotations
@@ -33,15 +34,19 @@ _load_failed = False
 
 def _build() -> bool:
     os.makedirs(_LIB_DIR, exist_ok=True)
-    cmd = [
-        "g++", "-O3", "-std=c++17", "-shared", "-fPIC",
-        "-o", _LIB, _SRC, "-ljpeg", "-lpthread",
-    ]
-    try:
-        subprocess.run(cmd, check=True, capture_output=True, timeout=120)
-        return True
-    except Exception:
-        return False
+    base = ["g++", "-O3", "-std=c++17", "-shared", "-fPIC", "-o", _LIB, _SRC]
+    # libpng is optional: on hosts without it, fall back to a JPEG-only
+    # build (-DDP_NO_PNG) rather than silently losing the whole native
+    # path — PNGs then take the per-slot PIL retry, JPEGs stay native.
+    for extra in (["-ljpeg", "-lpng", "-lpthread"],
+                  ["-DDP_NO_PNG", "-ljpeg", "-lpthread"]):
+        try:
+            subprocess.run(base + extra, check=True, capture_output=True,
+                           timeout=120)
+            return True
+        except Exception:
+            continue
+    return False
 
 
 def get_lib() -> Optional[ctypes.CDLL]:
@@ -56,25 +61,41 @@ def get_lib() -> Optional[ctypes.CDLL]:
             if not _build():
                 _load_failed = True
                 return None
-        try:
-            lib = ctypes.CDLL(_LIB)
-        except OSError:
-            _load_failed = True
-            return None
-        lib.dp_load_batch.restype = ctypes.c_int
-        lib.dp_load_batch.argtypes = [
-            ctypes.POINTER(ctypes.c_char_p), ctypes.c_int,
-            ctypes.POINTER(ctypes.c_float), ctypes.c_int, ctypes.c_int,
-            ctypes.c_int, ctypes.c_int, ctypes.c_double, ctypes.c_double,
-            ctypes.c_uint64, ctypes.POINTER(ctypes.c_float),
-            ctypes.POINTER(ctypes.c_float), ctypes.c_int,
-        ]
-        _lib = lib
-        return _lib
+        for attempt in (0, 1):
+            try:
+                lib = ctypes.CDLL(_LIB)
+                lib.dp_has_png.restype = ctypes.c_int
+                lib.dp_has_png.argtypes = []
+                lib.dp_load_batch.restype = ctypes.c_int
+                lib.dp_load_batch.argtypes = [
+                    ctypes.POINTER(ctypes.c_char_p), ctypes.c_int,
+                    ctypes.POINTER(ctypes.c_float), ctypes.c_int, ctypes.c_int,
+                    ctypes.c_int, ctypes.c_int, ctypes.c_double, ctypes.c_double,
+                    ctypes.c_uint64, ctypes.POINTER(ctypes.c_float),
+                    ctypes.POINTER(ctypes.c_float), ctypes.c_int,
+                ]
+                _lib = lib
+                return _lib
+            except (OSError, AttributeError):
+                # AttributeError = a stale binary predating a symbol (the
+                # mtime guard can miss, e.g. copied trees): rebuild once,
+                # then give up into the documented Python fallback
+                if attempt == 0 and _build():
+                    continue
+                _load_failed = True
+                return None
 
 
 _MEAN = (ctypes.c_float * 3)(*IMAGENET_MEAN)
 _STD = (ctypes.c_float * 3)(*IMAGENET_STD)
+
+
+def native_decodes_png() -> bool:
+    """True when the loaded dataplane build includes libpng (False for the
+    JPEG-only -DDP_NO_PNG fallback, where PNGs take the per-slot PIL
+    retry)."""
+    lib = get_lib()
+    return bool(lib is not None and lib.dp_has_png())
 
 
 def native_load_batch(
@@ -86,7 +107,7 @@ def native_load_batch(
     seed: int = 0,
     num_threads: int = 4,
 ) -> Optional[Tuple[np.ndarray, int]]:
-    """Decode+transform a list of JPEG paths into (B, S, S, 3) f32.
+    """Decode+transform a list of JPEG/PNG paths into (B, S, S, 3) f32.
 
     Returns (batch, n_failures) or None when the native library is
     unavailable. Failure slots are zero-filled; the caller patches them via
@@ -110,8 +131,9 @@ def native_load_batch(
 class NativeBatcher:
     """Batch assembler for `ShardedLoader(batcher=...)` over a path-based
     dataset (ImageFolderDataset). One native call per batch; slots the C side
-    could not decode (non-JPEG/corrupt) are re-loaded through the dataset's
-    PIL transform, so behavior is identical up to resampling details."""
+    could not decode (unsupported format/corrupt) are re-loaded through the
+    dataset's PIL transform, so behavior is identical up to resampling
+    details."""
 
     # native path covers these presets (RRC+flip / resize+center-crop);
     # 'cdr' (rotation) and 'cifar' (pad+crop on raw 32px) stay in Python

@@ -1,4 +1,4 @@
-// Native host dataplane: JPEG decode → crop/resize → flip → normalize,
+// Native host dataplane: JPEG/PNG decode → crop/resize → flip → normalize,
 // multithreaded, one call per batch.
 //
 // This is the TPU framework's native-code replacement for the reference's
@@ -6,12 +6,14 @@
 // worker processes running PIL + torchvision transforms per sample
 // (reference BASELINE/main.py:58-76,130-131). One C call fills a whole
 // NHWC float32 batch buffer that jax can ship to device without further
-// host-side work. Decoding uses libjpeg directly; crops follow torchvision
-// semantics (RandomResizedCrop(scale, ratio 3/4..4/3, 10 tries, fallback
-// center; val: resize-short-side + center crop) so training recipes match
-// the reference's augmentation distribution.
+// host-side work. Decoding dispatches on file magic bytes to libjpeg or
+// libpng (PIL `convert("RGB")` semantics: palette/gray expanded, alpha
+// dropped); crops follow torchvision semantics (RandomResizedCrop(scale,
+// ratio 3/4..4/3, 10 tries, fallback center; val: resize-short-side +
+// center crop) so training recipes match the reference's augmentation
+// distribution.
 //
-// Build: g++ -O3 -march=native -shared -fPIC -o libdataplane.so dataplane.cpp -ljpeg -lpthread
+// Build: g++ -O3 -march=native -shared -fPIC -o libdataplane.so dataplane.cpp -ljpeg -lpng -lpthread
 
 #include <algorithm>
 #include <atomic>
@@ -23,6 +25,9 @@
 #include <vector>
 
 #include <jpeglib.h>
+#ifndef DP_NO_PNG
+#include <png.h>
+#endif
 #include <csetjmp>
 
 namespace {
@@ -86,6 +91,76 @@ bool decode_jpeg(const char* path, std::vector<uint8_t>& out, int& w, int& h) {
   jpeg_destroy_decompress(&cinfo);
   fclose(f);
   return true;
+}
+
+#ifndef DP_NO_PNG
+// Decode a PNG file to RGB u8 via libpng. PIL-convert("RGB") semantics:
+// 16-bit → 8-bit, palette/gray expanded to RGB, alpha channel dropped
+// (not composited — PIL's convert discards it too). Interlaced images are
+// handled by libpng itself. Returns true on success.
+bool decode_png(FILE* f, std::vector<uint8_t>& out, int& w, int& h) {
+  png_structp png =
+      png_create_read_struct(PNG_LIBPNG_VER_STRING, nullptr, nullptr, nullptr);
+  if (!png) return false;
+  png_infop info = png_create_info_struct(png);
+  if (!info) {
+    png_destroy_read_struct(&png, nullptr, nullptr);
+    return false;
+  }
+  if (setjmp(png_jmpbuf(png))) {
+    png_destroy_read_struct(&png, &info, nullptr);
+    return false;
+  }
+  png_init_io(png, f);
+  png_read_info(png, info);
+  png_set_strip_16(png);
+  png_set_packing(png);
+  png_set_palette_to_rgb(png);
+  png_set_expand_gray_1_2_4_to_8(png);
+  png_set_gray_to_rgb(png);
+  png_set_strip_alpha(png);
+  int passes = png_set_interlace_handling(png);
+  png_read_update_info(png, info);
+  w = (int)png_get_image_width(png, info);
+  h = (int)png_get_image_height(png, info);
+  if (png_get_rowbytes(png, info) != (size_t)w * 3) {
+    png_destroy_read_struct(&png, &info, nullptr);
+    return false;  // transform chain failed to land on tight RGB rows
+  }
+  out.resize((size_t)w * h * 3);
+  // Row-by-row into the caller's buffer: no local non-trivial object lives
+  // across the setjmp/longjmp error path (a vector constructed after setjmp
+  // would have its destructor skipped by a corrupt-file longjmp — per-file
+  // leak); `out` belongs to the caller, so its cleanup is never skipped.
+  for (int p = 0; p < passes; ++p)
+    for (int y = 0; y < h; ++y)
+      png_read_row(png, out.data() + (size_t)y * w * 3, nullptr);
+  png_destroy_read_struct(&png, &info, nullptr);
+  return true;
+}
+
+#endif  // DP_NO_PNG
+
+// Decode a JPEG or PNG file to RGB u8, dispatching on magic bytes.
+// (Built with -DDP_NO_PNG when libpng is absent: JPEG-only, PNGs fall
+// through to the caller's PIL retry path.)
+bool decode_image(const char* path, std::vector<uint8_t>& out, int& w, int& h) {
+  FILE* f = fopen(path, "rb");
+  if (!f) return false;
+  uint8_t magic[8] = {0};
+  size_t got = fread(magic, 1, sizeof(magic), f);
+  rewind(f);
+#ifndef DP_NO_PNG
+  if (got >= 8 && png_sig_cmp(magic, 0, 8) == 0) {
+    bool ok = decode_png(f, out, w, h);
+    fclose(f);
+    return ok;
+  }
+#endif
+  fclose(f);
+  if (got >= 2 && magic[0] == 0xFF && magic[1] == 0xD8)
+    return decode_jpeg(path, out, w, h);
+  return false;
 }
 
 // ------------------------------------------------------------ resample -----
@@ -182,8 +257,8 @@ void worker(BatchJob* job) {
     int i = job->next.fetch_add(1);
     if (i >= job->n) return;
     float* dst = job->out + (size_t)i * job->out_h * job->out_w * 3;
-    if (!decode_jpeg(job->paths[i], buf, w, h)) {
-      // unreadable/non-JPEG: zero-fill; caller may retry via the Python path
+    if (!decode_image(job->paths[i], buf, w, h)) {
+      // unreadable/unsupported format: zero-fill; caller retries via PIL
       std::memset(dst, 0, sizeof(float) * job->out_h * job->out_w * 3);
       job->errors.fetch_add(1);
       continue;
@@ -244,12 +319,21 @@ int dp_load_batch(const char** paths, int n, float* out, int out_h, int out_w,
   return job.errors.load();
 }
 
-// Decode a single JPEG into out (caller-allocated w*h*3 after probing).
-// Probe: returns 0 on success and writes w/h; -1 on failure.
-int dp_probe_jpeg(const char* path, int* w, int* h) {
+// Capability probe: 1 when this build decodes PNG, 0 for the JPEG-only
+// -DDP_NO_PNG fallback (callers/tests can degrade instead of failing).
+int dp_has_png(void) {
+#ifndef DP_NO_PNG
+  return 1;
+#else
+  return 0;
+#endif
+}
+
+// Probe a JPEG/PNG: returns 0 on success and writes w/h; -1 on failure.
+int dp_probe_image(const char* path, int* w, int* h) {
   std::vector<uint8_t> buf;
   int ww, hh;
-  if (!decode_jpeg(path, buf, ww, hh)) return -1;
+  if (!decode_image(path, buf, ww, hh)) return -1;
   *w = ww;
   *h = hh;
   return 0;
