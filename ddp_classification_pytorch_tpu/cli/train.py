@@ -51,6 +51,10 @@ def build_parser() -> argparse.ArgumentParser:
                    help="per-class cap (500 baseline / 400 arcface)")
     d.add_argument("--num_workers", type=int, default=0, help="host loader threads")
     d.add_argument("--image_size", type=int, default=0)
+    d.add_argument("--crop_size", type=int, default=0,
+                   help="train-crop / resize-short side (default 256, the "
+                        "reference's RandomResizedCrop(256); set ~= "
+                        "--image_size for small-image folders)")
     d.add_argument("--transform", default="",
                    help="transform preset for imagefolder data: baseline | "
                         "cdr | cifar | clothing1m (default: workload preset; "
@@ -64,6 +68,11 @@ def build_parser() -> argparse.ArgumentParser:
     m.add_argument("--flash_attention", action="store_true",
                    help="ViT: Pallas streaming attention kernel for the "
                         "unsharded path")
+    m.add_argument("--flash_min_tokens", type=int, default=-1,
+                   help="auto-pick floor: below this token count "
+                        "--flash_attention uses XLA's fused dense attention "
+                        "instead of the kernel (default 1024, the measured "
+                        "v5e crossover region; 0 = kernel always)")
     m.add_argument("--variant", default="", help="imagenet | cifar stem")
     m.add_argument("--pretrained", action="store_true",
                    help="load converted torchvision weights")
@@ -148,6 +157,9 @@ def build_parser() -> argparse.ArgumentParser:
                         "(dependency-free writer, utils/tensorboard.py)")
     r.add_argument("--log_every", type=int, default=0)
     r.add_argument("--save_best_only", action="store_true")
+    r.add_argument("--keep_checkpoints", type=int, default=0,
+                   help="prune per-epoch checkpoints beyond the newest N "
+                        "(0 = keep all; ckpt_best is always kept)")
     r.add_argument("--profile_steps", type=int, default=0,
                    help="capture a jax.profiler trace of N train steps")
     r.add_argument("--debug_nans", action="store_true",
@@ -228,6 +240,8 @@ def config_from_args(args: argparse.Namespace) -> Config:
         cfg.data.num_workers = args.num_workers
     if args.image_size:
         cfg.data.image_size = args.image_size
+    if args.crop_size:
+        cfg.data.train_crop_size = args.crop_size
     if args.transform:
         cfg.data.transform = args.transform
 
@@ -235,6 +249,8 @@ def config_from_args(args: argparse.Namespace) -> Config:
         cfg.model.arch = args.model
     if args.flash_attention:
         cfg.model.flash_attention = True
+    if args.flash_min_tokens >= 0:
+        cfg.model.flash_min_tokens = args.flash_min_tokens
     if args.variant:
         cfg.model.variant = args.variant
     if args.pretrained:
@@ -299,6 +315,8 @@ def config_from_args(args: argparse.Namespace) -> Config:
         cfg.run.log_every = args.log_every
     if args.save_best_only:
         cfg.run.save_best_only = True
+    if args.keep_checkpoints:
+        cfg.run.keep_checkpoints = args.keep_checkpoints
     if args.profile_steps:
         cfg.run.profile_steps = args.profile_steps
     if args.debug_nans:

@@ -91,9 +91,17 @@ class ModelConfig:
     # load_balance_loss, sown per block, summed into the training loss)
     moe_aux_weight: float = 0.01
     # ViT family: use the Pallas streaming flash-attention kernel for the
-    # unsharded attention path (ops/flash_attention.py); ring-sharded
-    # attention ignores it
+    # unsharded attention path (ops/flash_attention.py); the ring-sharded
+    # path consumes each visiting KV shard with it too
     flash_attention: bool = False
+    # Auto-pick floor for the unsharded path: below this token count,
+    # --flash_attention routes to XLA's fused dense attention instead of the
+    # kernel (measured on v5e: flash wins from ~2048 tokens, dense is
+    # equal-or-better in the hundreds — docs/performance.md knob #4).
+    # 0 = always use the kernel. The ring path ignores this floor: there the
+    # kernel's job is keeping the per-shard score tile unmaterialized, which
+    # matters at any length.
+    flash_min_tokens: int = 1024
 
 
 @dataclass

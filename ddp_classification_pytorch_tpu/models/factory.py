@@ -77,7 +77,7 @@ def build_backbone(cfg: ModelConfig, num_classes: int = 0,
             dropout=cfg.dropout, mesh=mesh if (seq or moe_axis) else None,
             seq_axis=seq, remat=cfg.remat, use_flash=cfg.flash_attention,
             moe_experts=cfg.moe_experts, moe_top_k=cfg.moe_top_k,
-            moe_axis=moe_axis,
+            moe_axis=moe_axis, flash_min_tokens=cfg.flash_min_tokens,
         )
     raise ValueError(f"unknown arch {cfg.arch!r}")
 

@@ -48,6 +48,10 @@ class MHA(nn.Module):
     mesh: Optional[Any] = None
     seq_axis: Optional[str] = None
     use_flash: bool = False
+    # unsharded-path auto-pick: below this (static) token count the dense
+    # XLA op is used even when use_flash is set (0 = kernel always). The
+    # ring path is exempt — see ModelConfig.flash_min_tokens.
+    flash_min_tokens: int = 0
 
     @nn.compact
     def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
@@ -56,12 +60,14 @@ class MHA(nn.Module):
         qkv = nn.Dense(3 * self.dim, dtype=self.dtype, name="qkv")(x)
         qkv = qkv.reshape(b, t, 3, self.heads, d)
         q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+        use_flash = self.use_flash and (
+            self.seq_axis is not None or t >= self.flash_min_tokens)
         # ring_attention owns the whole dispatch: sharded token axis → ring
         # (with the flash kernel consuming each visiting KV shard when
         # use_flash), unsharded → direct flash or dense.
         out = ring_attention(q, k, v, mesh=self.mesh,
                              axis_name=self.seq_axis,
-                             use_flash=self.use_flash)
+                             use_flash=use_flash)
         out = out.reshape(b, t, self.dim)
         return nn.Dense(self.dim, dtype=self.dtype, name="proj")(out)
 
@@ -82,12 +88,14 @@ class Block(nn.Module):
     moe_experts: int = 0
     moe_top_k: int = 2
     moe_axis: Optional[str] = None
+    flash_min_tokens: int = 0
 
     @nn.compact
     def __call__(self, x: jnp.ndarray, train: bool = True) -> jnp.ndarray:
         y = nn.LayerNorm(dtype=jnp.float32, name="ln1")(x).astype(self.dtype)
         x = x + MHA(self.dim, self.heads, self.dtype, self.mesh,
-                    self.seq_axis, self.use_flash, name="attn")(y)
+                    self.seq_axis, self.use_flash,
+                    self.flash_min_tokens, name="attn")(y)
         y = nn.LayerNorm(dtype=jnp.float32, name="ln2")(x).astype(self.dtype)
         if self.moe_experts > 0:
             from ..ops.moe import (
@@ -163,6 +171,7 @@ class ViT(nn.Module):
     moe_experts: int = 0
     moe_top_k: int = 2
     moe_axis: Optional[str] = None
+    flash_min_tokens: int = 0
 
     @nn.compact
     def __call__(self, x: jnp.ndarray, train: bool = True) -> jnp.ndarray:
@@ -181,6 +190,7 @@ class ViT(nn.Module):
             x = block_cls(self.dim, self.heads, self.dtype, self.dropout,
                           self.mesh, self.seq_axis, self.use_flash,
                           self.moe_experts, self.moe_top_k, self.moe_axis,
+                          self.flash_min_tokens,
                           name=f"block{i}")(x, train)
         x = nn.LayerNorm(dtype=jnp.float32, name="ln_final")(x)
         x = x.mean(axis=1)  # token mean-pool; shard-friendly (see module doc)
@@ -194,10 +204,12 @@ def build_vit(arch: str, num_classes: int = 0, dtype: Any = jnp.bfloat16,
               dropout: float = 0.0, mesh: Optional[Any] = None,
               seq_axis: Optional[str] = None, remat: bool = False,
               use_flash: bool = False, moe_experts: int = 0,
-              moe_top_k: int = 2, moe_axis: Optional[str] = None) -> ViT:
+              moe_top_k: int = 2, moe_axis: Optional[str] = None,
+              flash_min_tokens: int = 0) -> ViT:
     patch, dim, depth, heads = VIT_CONFIGS[arch]
     return ViT(patch=patch, dim=dim, depth=depth, heads=heads,
                num_classes=num_classes, dtype=dtype, dropout=dropout,
                mesh=mesh, seq_axis=seq_axis, remat=remat,
                use_flash=use_flash, moe_experts=moe_experts,
-               moe_top_k=moe_top_k, moe_axis=moe_axis)
+               moe_top_k=moe_top_k, moe_axis=moe_axis,
+               flash_min_tokens=flash_min_tokens)

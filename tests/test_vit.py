@@ -101,3 +101,44 @@ def test_vit_arcface_head_composes():
         eval_step = make_eval_step(cfg, model)
         out = eval_step(state, images, labels, jnp.ones((8,)))
         assert np.isfinite(float(out["loss_sum"]))
+
+
+def test_flash_min_tokens_autopick(monkeypatch):
+    """Below the flash_min_tokens floor, --flash_attention must route the
+    unsharded path to dense attention (measured: dense is equal-or-better
+    in the hundreds of tokens, docs/performance.md knob #4); at/above the
+    floor — and always when floor=0 — the Pallas kernel runs."""
+    import importlib
+
+    attn_mod = importlib.import_module(
+        "ddp_classification_pytorch_tpu.ops.attention")
+
+    calls = []
+    real = attn_mod.ring_attention
+
+    def spy(q, k, v, **kw):
+        calls.append(kw.get("use_flash", False))
+        return real(q, k, v, **kw)
+
+    monkeypatch.setattr("ddp_classification_pytorch_tpu.models.vit.ring_attention", spy)
+
+    x = jnp.zeros((2, 64, 64, 3))  # 16 tokens
+    for floor, expect_flash in [(1024, False), (0, True), (16, True)]:
+        calls.clear()
+        model = build_vit("vit_t16", num_classes=0, dtype=jnp.float32,
+                          use_flash=True, flash_min_tokens=floor)
+        vs = model.init(jax.random.PRNGKey(0), x, train=False)
+        model.apply(vs, x, train=False)
+        assert calls and all(c == expect_flash for c in calls), (floor, calls)
+
+
+def test_flash_min_tokens_config_plumbs_to_model():
+    from ddp_classification_pytorch_tpu.models.factory import build_backbone
+
+    cfg = get_preset("baseline")
+    cfg.model.arch = "vit_t16"
+    cfg.model.flash_attention = True
+    cfg.model.flash_min_tokens = 512
+    vit = build_backbone(cfg.model, 10)
+    assert vit.use_flash is True
+    assert vit.flash_min_tokens == 512
