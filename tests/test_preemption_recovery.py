@@ -1,0 +1,164 @@
+"""Automated preemption-recovery chain test (VERDICT r2 weak #4).
+
+Round 2 proved recovery manually once (a real mid-run kill during the PLC
+digits run, docs/convergence.md); this test automates the WHOLE chain as
+one path: subprocess PLC training → SIGKILL mid-epoch → restart via
+`scripts/supervise.sh` (whose restart command is the start command plus
+`--auto_resume`) → assert the epoch counter continues, the optimizer/model
+state is restored, the corrected labels + δ are restored, and the
+post-resume per-epoch metrics match an uninterrupted control run.
+
+The metric-equality assertion works because every nondeterminism source is
+keyed, not ambient: the epoch permutation is seeded by (seed, epoch)
+(data/loader.py::shard_indices_for_host), per-sample transform rngs by
+(seed, epoch, index, slot), and the restored TrainState is exact — so a
+resumed epoch N replays the uninterrupted epoch N bit-for-bit on the same
+host.
+"""
+
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+from PIL import Image
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+pytestmark = pytest.mark.skipif(
+    os.environ.get("SKIP_SUBPROCESS_TESTS") == "1",
+    reason="subprocess-heavy chain test disabled by env",
+)
+
+
+def _write_imagefolder(root, classes=2, per_train=64, per_val=16, size=32):
+    """Structured images (class-dependent gradients + noise) so two classes
+    are actually separable and training/eval metrics move."""
+    rng = np.random.default_rng(7)
+    for split, per in (("train", per_train), ("val", per_val)):
+        for c in range(classes):
+            d = root / split / f"class{c}"
+            d.mkdir(parents=True)
+            for i in range(per):
+                ramp = np.linspace(0, 255, size) if c == 0 else np.linspace(255, 0, size)
+                base = np.broadcast_to(ramp[None, :], (size, size))
+                img = np.stack([base] * 3, 2) + rng.normal(0, 30, (size, size, 3))
+                Image.fromarray(np.clip(img, 0, 255).astype(np.uint8)).save(
+                    d / f"img{i}.png")
+
+
+def _cmd(folder, out, epochs):
+    return [
+        sys.executable, "-m", "ddp_classification_pytorch_tpu.cli.train", "plc",
+        "--folder", str(folder), "--transform", "cifar", "--image_size", "32",
+        "--variant", "cifar", "--model", "resnet18", "--num_classes", "2",
+        "--batchsize", "16", "--num_workers", "2", "--lr", "0.05",
+        "--epochs", str(epochs), "--correction", "lrt",
+        "--plc_warmup_epochs", "0", "--out", str(out), "--seed", "123",
+        "--platform", "cpu", "--auto_resume",
+    ]
+
+
+def _env():
+    env = dict(os.environ)
+    # single virtual device keeps the subprocess light; determinism does not
+    # depend on the device count (it is keyed per (seed, epoch, index))
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=1"
+    return env
+
+
+def _epoch_rows(out_dir):
+    """output.txt → {epoch: {metric: value}} (last occurrence wins)."""
+    rows = {}
+    with open(os.path.join(out_dir, "output.txt")) as f:
+        for line in f:
+            if not line.startswith("epoch:"):
+                continue
+            fields = dict(kv.split(":", 1) for kv in line.strip().split("\t"))
+            e = int(fields.pop("epoch"))
+            rows[e] = {k: float(v) for k, v in fields.items()}
+    return rows
+
+
+def test_kill_mid_epoch_then_supervise_resume_matches_uninterrupted(tmp_path):
+    data = tmp_path / "data"
+    _write_imagefolder(data)
+    epochs = 8
+    out_a = tmp_path / "uninterrupted"
+    out_b = tmp_path / "preempted"
+
+    # Control: one clean run to completion.
+    r = subprocess.run(_cmd(data, out_a, epochs), env=_env(), cwd=REPO,
+                       capture_output=True, text=True, timeout=600)
+    assert r.returncode == 0, r.stderr[-2000:]
+    rows_a = _epoch_rows(out_a)
+    assert set(rows_a) == set(range(epochs))
+
+    # Preempted: SIGKILL as soon as epoch 1's checkpoint lands — a hard
+    # kill with later epochs still outstanding, like a real preemption.
+    # No grace sleep: on a fast host a fixed sleep could let the remaining
+    # epochs finish and make the kill vacuous.
+    proc = subprocess.Popen(_cmd(data, out_b, epochs), env=_env(), cwd=REPO,
+                            stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
+    marker = out_b / "ckpt_e1.msgpack"
+    deadline = time.time() + 420
+    while not marker.exists():
+        assert proc.poll() is None, "training exited before it could be killed"
+        assert time.time() < deadline, "no epoch-1 checkpoint within budget"
+        time.sleep(0.05)
+    proc.send_signal(signal.SIGKILL)
+    proc.wait(timeout=60)
+    if proc.returncode == 0:  # host outran the kill — nothing was preempted
+        pytest.skip("run completed before SIGKILL landed; host too fast "
+                    "for a meaningful preemption")
+    assert proc.returncode != 0
+
+    killed_rows = _epoch_rows(out_b)
+    assert max(killed_rows) < epochs - 1, "nothing left to resume"
+
+    # Recovery: supervise.sh reruns the IDENTICAL command (it appends
+    # --auto_resume itself; the flag is idempotent) until rc=0.
+    r2 = subprocess.run(
+        ["bash", os.path.join(REPO, "scripts", "supervise.sh")]
+        + _cmd(data, out_b, epochs)[3:],  # supervise prepends `python -m <module>`
+        env={**_env(), "MAX_RESTARTS": "2"},
+        cwd=REPO, capture_output=True, text=True, timeout=900)
+    assert r2.returncode == 0, (r2.stdout[-1000:], r2.stderr[-2000:])
+    assert "auto-resumed" in r2.stdout
+
+    rows_b = _epoch_rows(out_b)
+    # epoch counter continued: every epoch present exactly once, no restart
+    # from zero (epoch rows before the kill survive in output.txt)
+    assert set(rows_b) == set(range(epochs))
+
+    # post-resume curve matches the uninterrupted control — this is the
+    # optimizer/model/label/δ restoration check in one observable: any lost
+    # state would diverge the replayed epochs
+    for e in range(epochs):
+        for k, va in rows_a[e].items():
+            if k == "epoch_time":
+                continue
+            np.testing.assert_allclose(
+                rows_b[e][k], va, rtol=1e-4, atol=1e-5,
+                err_msg=f"epoch {e} metric {k}: preempted run diverged")
+
+    # corrected labels + δ restored and equal to the control's
+    la = np.load(out_a / "plc_labels.npy")
+    lb = np.load(out_b / "plc_labels.npy")
+    np.testing.assert_array_equal(la, lb)
+    import json
+
+    meta_a = json.load(open(out_a / "meta.json"))
+    meta_b = json.load(open(out_b / "meta.json"))
+    assert meta_a.get("last_epoch") == meta_b.get("last_epoch") == epochs - 1
+    if "plc_delta" in meta_a or "plc_delta" in meta_b:
+        assert meta_a.get("plc_delta") == meta_b.get("plc_delta")
+
+    # history.json carries the FULL curve after resume (ADVICE r2: resumed
+    # runs must append to the pre-preemption history, not overwrite it)
+    hist = json.load(open(out_b / "history.json"))
+    lengths = {k: len(v) for k, v in hist.items()}
+    assert all(n == epochs for n in lengths.values()), lengths
