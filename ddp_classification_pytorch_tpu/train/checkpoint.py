@@ -204,8 +204,13 @@ class CheckpointManager:
     def _write_meta(self, **kw: Any) -> None:
         meta = self.read_meta()
         meta.update(kw)
-        with open(self.meta_path, "w") as f:
+        # atomic tmp+replace: a preemption mid-write must not tear the file
+        # auto-resume depends on — a torn meta.json would crash every
+        # restart attempt identically and brick the recovery chain
+        tmp = self.meta_path + ".tmp"
+        with open(tmp, "w") as f:
             json.dump(meta, f, indent=1)
+        os.replace(tmp, self.meta_path)
 
     def read_meta(self) -> dict:
         return self.read_meta_at(self.meta_path)
@@ -214,7 +219,12 @@ class CheckpointManager:
     def read_meta_at(meta_path: str) -> dict:
         if os.path.exists(meta_path):
             with open(meta_path) as f:
-                return json.load(f)
+                try:
+                    return json.load(f)
+                except json.JSONDecodeError:
+                    # legacy torn file (pre-atomic-write runs): resuming
+                    # with default meta beats crashing every retry
+                    return {}
         return {}
 
     @staticmethod

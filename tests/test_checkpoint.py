@@ -160,3 +160,23 @@ def test_auto_resume_trainer_e2e(tmp_path):
     m = tr2.train_epoch(tr2.start_epoch)
     assert np.isfinite(m["loss"])
     assert int(tr2.state.step) > step_before
+
+
+def test_torn_meta_json_does_not_brick_resume(tmp_path):
+    """meta.json writes are atomic (tmp+replace), and the reader tolerates a
+    legacy torn file: a preemption landing mid-meta-write must not crash
+    every subsequent --auto_resume attempt identically (the recovery chain
+    would be bricked with MAX_RESTARTS exhausted)."""
+    from ddp_classification_pytorch_tpu.train.checkpoint import CheckpointManager
+
+    out = tmp_path / "run"
+    out.mkdir()
+    (out / "meta.json").write_text('{"last_epoch": 3, "best_')  # torn
+    assert CheckpointManager.read_meta_at(str(out / "meta.json")) == {}
+
+    mgr = CheckpointManager(str(out), save_every_epoch=False, best_only=False,
+                            keep=0, async_save=False)
+    mgr._write_meta(last_epoch=7)  # must replace the torn file atomically
+    assert CheckpointManager.read_meta_at(str(out / "meta.json")) == {
+        "last_epoch": 7}
+    assert not (out / "meta.json.tmp").exists()
