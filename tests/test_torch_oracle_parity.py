@@ -1,14 +1,15 @@
-"""Full-model torch-vs-flax forward parity through the weight converter.
+"""Full-model torch-vs-flax forward parity through the weight converters.
 
-The strongest available proxy for "pretrained torchvision checkpoints load
-correctly" in a zero-egress sandbox (VERDICT r2 missing #2): build the
-torchvision architecture in torch (tests/torch_resnet_oracle.py), randomize
-every parameter and buffer, push its real `state_dict()` through
-`convert_resnet_state_dict` + `merge_into_variables`, and require the flax
-model to reproduce the torch forward end to end in f32 — stride-2 paths,
-downsample branches, BN eval statistics, pooling and the fc head included.
-Any drift in layer mapping, transpose convention, padding choice, or BN
-epsilon fails these tests.
+The strongest available proxy for "pretrained torchvision/timm checkpoints
+load correctly" in a zero-egress sandbox (VERDICT r2 missing #2): build
+each architecture in torch with its upstream parameter naming
+(tests/torch_resnet_oracle.py), randomize every parameter and buffer, push
+the real `state_dict()` through the matching converter +
+`merge_into_variables`, and require the flax model to reproduce the torch
+forward end to end in f32 — stride-2 paths, downsample branches, BN eval
+statistics, pooling, flatten orderings and heads included. Any drift in
+layer mapping, transpose convention, padding choice, or BN epsilon fails
+these tests.
 """
 
 import numpy as np
@@ -25,11 +26,19 @@ from ddp_classification_pytorch_tpu.models.import_torch import (
 
 torch = pytest.importorskip("torch")
 
-from torch_resnet_oracle import make_torch_resnet, randomize_  # noqa: E402
+from torch_resnet_oracle import (  # noqa: E402
+    make_torch_resnet,
+    make_torch_tresnet_m,
+    make_torch_vgg19_bn,
+    randomize_,
+)
 
 
-def _forward_pair(arch: str, num_classes: int, image_size: int, seed: int):
-    tmodel = make_torch_resnet(arch, num_classes)
+def _forward_pair(make_oracle, make_flax, converter, image_size, seed,
+                  init_rngs=None):
+    """Shared harness: randomized torch oracle → state_dict → converter →
+    flax forward, both in f32 eval mode on the same input."""
+    tmodel = make_oracle()
     randomize_(tmodel, seed=seed)
     tmodel.eval()
 
@@ -38,34 +47,34 @@ def _forward_pair(arch: str, num_classes: int, image_size: int, seed: int):
     with torch.no_grad():
         ref = tmodel(torch.from_numpy(x)).numpy()
 
-    fmodel = getattr(R, arch)(num_classes=num_classes, dtype=jnp.float32)
-    variables = fmodel.init(jax.random.PRNGKey(0),
+    fmodel = make_flax()
+    variables = fmodel.init(init_rngs or jax.random.PRNGKey(0),
                             jnp.zeros((1, image_size, image_size, 3)),
                             train=False)
-    converted = convert_resnet_state_dict(tmodel.state_dict())
-    merged = merge_into_variables(variables, converted)
+    merged = merge_into_variables(variables, converter(tmodel.state_dict()))
     out = fmodel.apply(merged, jnp.asarray(x.transpose(0, 2, 3, 1)),
                        train=False)
     return np.asarray(out), ref
 
 
-@pytest.mark.parametrize("arch,image_size", [
-    ("resnet18", 64),   # BasicBlock path, every stride-2 stage transition
-    ("resnet50", 64),   # Bottleneck path incl. the stride-1 layer1 downsample
-])
-def test_full_model_forward_matches_torch(arch, image_size):
-    got, ref = _forward_pair(arch, num_classes=37, image_size=image_size,
-                             seed={"resnet18": 0, "resnet50": 1}[arch])
-    np.testing.assert_allclose(got, ref, rtol=2e-4, atol=2e-4)
+def _assert_close(got, ref, tol):
+    np.testing.assert_allclose(got, ref, rtol=tol, atol=tol)
     # logits must be non-degenerate for the comparison to mean anything
     assert np.std(ref) > 1e-3
 
 
-def test_full_model_forward_matches_torch_odd_input():
-    """Odd spatial size exercises the asymmetric-padding trap: SAME padding
-    would shift the stride-2 grids; the explicit k//2 padding must not."""
-    got, ref = _forward_pair("resnet18", num_classes=11, image_size=75, seed=2)
-    np.testing.assert_allclose(got, ref, rtol=2e-4, atol=2e-4)
+@pytest.mark.parametrize("arch,image_size", [
+    ("resnet18", 64),   # BasicBlock path, every stride-2 stage transition
+    ("resnet50", 64),   # Bottleneck path incl. the stride-1 layer1 downsample
+    ("resnet18", 75),   # odd size: the asymmetric-SAME-padding trap
+])
+def test_resnet_full_model_forward_matches_torch(arch, image_size):
+    got, ref = _forward_pair(
+        lambda: make_torch_resnet(arch, 37),
+        lambda: getattr(R, arch)(num_classes=37, dtype=jnp.float32),
+        convert_resnet_state_dict, image_size,
+        seed={"resnet18": 0, "resnet50": 1}[arch] + (2 if image_size == 75 else 0))
+    _assert_close(got, ref, 2e-4)
 
 
 def test_feature_extractor_matches_torch_prepool():
@@ -90,3 +99,40 @@ def test_feature_extractor_matches_torch_prepool():
     got = fmodel.apply(merged, jnp.asarray(x.transpose(0, 2, 3, 1)),
                        train=False)
     np.testing.assert_allclose(np.asarray(got), ref, rtol=2e-4, atol=2e-4)
+
+
+def test_vgg19bn_full_model_forward_matches_torch():
+    """Same end-to-end contract for the VGG importer — including the
+    CHW-vs-HWC fc1 flatten permutation — at 224px (the 7x7 pre-flatten grid
+    both models assume)."""
+    from ddp_classification_pytorch_tpu.models.import_torch import (
+        convert_vgg_state_dict,
+    )
+    from ddp_classification_pytorch_tpu.models.vgg import vgg19_bn
+
+    got, ref = _forward_pair(
+        lambda: make_torch_vgg19_bn(num_classes=9),
+        lambda: vgg19_bn(num_classes=9, dtype=jnp.float32),
+        convert_vgg_state_dict, 224, seed=4,
+        init_rngs={"params": jax.random.PRNGKey(0),
+                   "dropout": jax.random.PRNGKey(1)})
+    _assert_close(got, ref, 5e-4)
+
+
+@pytest.mark.parametrize("image_size", [64, 104])  # 104: odd grids mid-net
+def test_tresnet_m_full_model_forward_matches_torch(image_size):
+    """End-to-end contract for the TResNet importer — the most intricate
+    mapping (aa-wrapped stride-2 convs, SE 1x1-conv squeeze, avg-pool
+    shortcut, space-to-depth stem channel order). 104px drives odd spatial
+    grids through the blur/ceil-mode-avg-pool pair, pinning their padding
+    parity."""
+    from ddp_classification_pytorch_tpu.models.import_torch import (
+        convert_tresnet_state_dict,
+    )
+    from ddp_classification_pytorch_tpu.models.tresnet import tresnet_m
+
+    got, ref = _forward_pair(
+        lambda: make_torch_tresnet_m(num_classes=6),
+        lambda: tresnet_m(num_classes=6, dtype=jnp.float32),
+        convert_tresnet_state_dict, image_size, seed=5)
+    _assert_close(got, ref, 5e-4)
