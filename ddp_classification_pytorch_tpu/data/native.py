@@ -32,9 +32,9 @@ _lib: Optional[ctypes.CDLL] = None
 _load_failed = False
 
 
-def _build() -> bool:
+def _build(target: str = _LIB) -> bool:
     os.makedirs(_LIB_DIR, exist_ok=True)
-    base = ["g++", "-O3", "-std=c++17", "-shared", "-fPIC", "-o", _LIB, _SRC]
+    base = ["g++", "-O3", "-std=c++17", "-shared", "-fPIC", "-o", target, _SRC]
     # libpng is optional: on hosts without it, fall back to a JPEG-only
     # build (-DDP_NO_PNG) rather than silently losing the whole native
     # path — PNGs then take the per-slot PIL retry, JPEGs stay native.
@@ -61,9 +61,10 @@ def get_lib() -> Optional[ctypes.CDLL]:
             if not _build():
                 _load_failed = True
                 return None
+        path = _LIB
         for attempt in (0, 1):
             try:
-                lib = ctypes.CDLL(_LIB)
+                lib = ctypes.CDLL(path)
                 lib.dp_has_png.restype = ctypes.c_int
                 lib.dp_has_png.argtypes = []
                 lib.dp_load_batch.restype = ctypes.c_int
@@ -78,9 +79,13 @@ def get_lib() -> Optional[ctypes.CDLL]:
                 return _lib
             except (OSError, AttributeError):
                 # AttributeError = a stale binary predating a symbol (the
-                # mtime guard can miss, e.g. copied trees): rebuild once,
-                # then give up into the documented Python fallback
-                if attempt == 0 and _build():
+                # mtime guard can miss, e.g. copied trees). Rebuild to a
+                # FRESH path: dlopen caches by name and ctypes never
+                # dlcloses, so rebuilding in place would hand back the same
+                # stale handle. One retry, then the documented Python
+                # fallback.
+                path = os.path.join(_LIB_DIR, f"libdataplane.r{os.getpid()}.so")
+                if attempt == 0 and _build(path):
                     continue
                 _load_failed = True
                 return None

@@ -52,6 +52,17 @@ struct Rng {
 };
 
 // ------------------------------------------------------------- decode ------
+// Header-declared dimensions are attacker-/corruption-controlled; cap them
+// before any allocation so a bogus header cannot drive out.resize() into
+// std::bad_alloc (training images are far below these bounds).
+constexpr int kMaxDim = 32768;
+constexpr long long kMaxPixels = 64LL * 1024 * 1024;  // 192 MB RGB
+
+bool dims_ok(int w, int h) {
+  return w > 0 && h > 0 && w <= kMaxDim && h <= kMaxDim &&
+         (long long)w * h <= kMaxPixels;
+}
+
 struct JpegErr {
   jpeg_error_mgr mgr;
   jmp_buf jb;
@@ -82,6 +93,11 @@ bool decode_jpeg(const char* path, std::vector<uint8_t>& out, int& w, int& h) {
   jpeg_start_decompress(&cinfo);
   w = cinfo.output_width;
   h = cinfo.output_height;
+  if (!dims_ok(w, h)) {
+    jpeg_destroy_decompress(&cinfo);
+    fclose(f);
+    return false;
+  }
   out.resize((size_t)w * h * 3);
   while (cinfo.output_scanline < cinfo.output_height) {
     uint8_t* row = out.data() + (size_t)cinfo.output_scanline * w * 3;
@@ -123,6 +139,10 @@ bool decode_png(FILE* f, std::vector<uint8_t>& out, int& w, int& h) {
   png_read_update_info(png, info);
   w = (int)png_get_image_width(png, info);
   h = (int)png_get_image_height(png, info);
+  if (!dims_ok(w, h)) {
+    png_destroy_read_struct(&png, &info, nullptr);
+    return false;
+  }
   if (png_get_rowbytes(png, info) != (size_t)w * 3) {
     png_destroy_read_struct(&png, &info, nullptr);
     return false;  // transform chain failed to land on tight RGB rows
@@ -257,8 +277,16 @@ void worker(BatchJob* job) {
     int i = job->next.fetch_add(1);
     if (i >= job->n) return;
     float* dst = job->out + (size_t)i * job->out_h * job->out_w * 3;
-    if (!decode_image(job->paths[i], buf, w, h)) {
-      // unreadable/unsupported format: zero-fill; caller retries via PIL
+    bool ok = false;
+    try {
+      ok = decode_image(job->paths[i], buf, w, h);
+    } catch (...) {
+      // an exception escaping a pool thread would std::terminate the
+      // whole trainer; a failed slot must degrade like any other
+      ok = false;
+    }
+    if (!ok) {
+      // unreadable/unsupported/oversized: zero-fill; caller retries via PIL
       std::memset(dst, 0, sizeof(float) * job->out_h * job->out_w * 3);
       job->errors.fetch_add(1);
       continue;

@@ -210,3 +210,29 @@ def test_bad_file_reported_and_zero_filled(tmp_path, jpegs):
     assert errors == 1
     assert np.abs(out[1]).sum() == 0.0
     assert np.abs(out[0]).sum() > 0.0
+
+
+def test_dimension_bomb_header_reported_not_crashed(tmp_path, pngs, png_support):
+    """A valid PNG signature declaring absurd dimensions (header bomb) must
+    be rejected BEFORE allocation — an std::bad_alloc escaping a pool
+    thread would std::terminate the whole trainer instead of degrading to
+    the zero-fill + PIL-retry contract."""
+    import struct
+    import zlib
+
+    def chunk(tag, data):
+        body = tag + data
+        return (struct.pack(">I", len(data)) + body
+                + struct.pack(">I", zlib.crc32(body) & 0xFFFFFFFF))
+
+    bomb = str(tmp_path / "bomb.png")
+    ihdr = struct.pack(">IIBBBBB", 1_000_000, 1_000_000, 8, 2, 0, 0, 0)
+    with open(bomb, "wb") as f:
+        f.write(b"\x89PNG\r\n\x1a\n" + chunk(b"IHDR", ihdr)
+                + chunk(b"IDAT", zlib.compress(b"\x00" * 16))
+                + chunk(b"IEND", b""))
+    out, errors = native_load_batch([bomb, pngs[0][0]], 96, train=False,
+                                    seed=0, num_threads=2)
+    assert errors == 1
+    assert np.abs(out[0]).sum() == 0.0
+    assert np.abs(out[1]).sum() > 0.0
