@@ -40,11 +40,14 @@ def main() -> None:
     from ddp_classification_pytorch_tpu.utils.cache import enable_persistent_cache
 
     enable_persistent_cache()
-    if args.platform == "cpu":
+    if args.platform:
+        # same contract as cli/train.py: an explicit flag pins the platform
+        # regardless of env (the sitecustomize pins axon; JAX_PLATFORMS in
+        # the env may pin something else)
         import jax
 
-        jax.config.update("jax_platforms", "cpu")
-    else:
+        jax.config.update("jax_platforms", args.platform)
+    if args.platform != "cpu":
         require_backend(attempts=2, probe_timeout=120)
 
     import jax
@@ -57,6 +60,14 @@ def main() -> None:
 
     devices = jax.devices()
     on_accel = devices[0].platform in ("tpu", "gpu")
+    if not on_accel and args.platform != "cpu":
+        # a lease outage can land the probe on the CPU backend; full-size
+        # vit_s16 steps would grind for hours and the numbers would not
+        # answer the TPU flash-vs-dense question anyway
+        raise SystemExit(
+            "backend is CPU but --platform cpu was not requested — refusing "
+            "to measure the TPU crossover on the host (pass --platform cpu "
+            "with small --sizes/--batch for a smoke run)")
     mesh = meshlib.make_mesh(devices=devices)
 
     for size in [int(s) for s in args.sizes.split(",") if s]:
