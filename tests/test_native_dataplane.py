@@ -2,6 +2,9 @@
 PNGs, and checks transform semantics against the Python/PIL pipeline."""
 
 
+import os
+import time
+
 import numpy as np
 import pytest
 from PIL import Image
@@ -236,3 +239,36 @@ def test_dimension_bomb_header_reported_not_crashed(tmp_path, pngs, png_support)
     assert errors == 1
     assert np.abs(out[0]).sum() == 0.0
     assert np.abs(out[1]).sum() > 0.0
+
+
+def test_stale_binary_without_new_symbol_recovers(tmp_path, monkeypatch):
+    """A stale libdataplane.so predating dp_has_png (mtime newer than the
+    source, so the rebuild guard misses) must not kill the native path:
+    get_lib rebuilds to a FRESH filename and loads that — rebuilding in
+    place cannot work because dlopen caches by name and ctypes never
+    dlcloses."""
+    import subprocess
+
+    from ddp_classification_pytorch_tpu.data import native as native_mod
+
+    stale_src = tmp_path / "stale.cpp"
+    stale_src.write_text(
+        'extern "C" int dp_load_batch() { return -1; }\n')  # no dp_has_png
+    stale_lib = str(tmp_path / "libdataplane.so")
+    subprocess.run(["g++", "-shared", "-fPIC", "-o", stale_lib,
+                    str(stale_src)], check=True)
+    future = time.time() + 3600
+    os.utime(stale_lib, (future, future))  # defeat the mtime rebuild guard
+
+    monkeypatch.setattr(native_mod, "_LIB", stale_lib)
+    monkeypatch.setattr(native_mod, "_LIB_DIR", str(tmp_path))
+    monkeypatch.setattr(native_mod, "_lib", None)
+    monkeypatch.setattr(native_mod, "_load_failed", False)
+    try:
+        lib = native_mod.get_lib()
+        assert lib is not None, "stale binary must trigger a fresh-path rebuild"
+        assert lib.dp_has_png() in (0, 1)
+    finally:
+        # never leak the stale/temp libs into the module for later tests
+        monkeypatch.setattr(native_mod, "_lib", None)
+        monkeypatch.setattr(native_mod, "_load_failed", False)
