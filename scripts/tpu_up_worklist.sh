@@ -1,0 +1,35 @@
+#!/usr/bin/env bash
+# Everything owed to the live chip, in priority order, for the next
+# tunnel-up window (rounds 2-3 were fully eclipsed by outages). Each step
+# is independently committed evidence; a window that closes mid-list still
+# leaves the earlier artifacts on disk. Serialize TPU access: nothing else
+# may hold the lease while this runs (docs/operations.md).
+#
+# Usage: bash scripts/tpu_up_worklist.sh [outdir]
+set -u
+out=${1:-runs/tpu_window_$(date +%m%d_%H%M)}
+mkdir -p "$out"
+
+echo "== 1/3 bench (the driver-comparable capture)" >&2
+python bench.py > "$out/bench.json" 2> "$out/bench.log"
+rc=$?
+tail -1 "$out/bench.json"
+[ $rc -ne 0 ] && echo "bench rc=$rc — backend likely down, stopping" >&2 && exit $rc
+
+echo "== 2/3 dense-vs-flash A/B at bench token counts" >&2
+python scripts/ab_vit_attention.py --sizes 224,448 \
+  > "$out/ab_attention.json" 2> "$out/ab_attention.log"
+cat "$out/ab_attention.json"
+
+echo "== 3/3 native-dataplane digits run on the chip (~5 min)" >&2
+python scripts/export_digits.py --root /tmp/digits
+python -m ddp_classification_pytorch_tpu.cli.train baseline \
+  --folder /tmp/digits --transform baseline --image_size 32 --crop_size 32 \
+  --variant cifar --model resnet18 --num_classes 10 --batchsize 128 \
+  --lr 0.1 --weight_decay 0.0005 --warmUpIter 36 --epochs 40 \
+  --lrSchedule 20 32 --out "$out/digits_rn18_native_tpu" --seed 999 \
+  --save_best_only 2>&1 | tail -3
+cat "$out/digits_rn18_native_tpu/meta.json" 2>/dev/null
+
+echo "window work complete — commit $out (bench.json, ab_attention.json," >&2
+echo "digits record) and fold the A/B crossover into flash_min_tokens" >&2
