@@ -1,0 +1,33 @@
+"""bench.py metadata consistency.
+
+LAST_KNOWN_GOOD is the outage-window fallback artifact; its numbers must
+stay bit-identical to the committed live capture in docs/performance.md or
+the two records drift apart silently (each looks authoritative).
+"""
+
+import json
+import os
+import re
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_last_known_good_matches_committed_capture():
+    import bench
+
+    with open(os.path.join(REPO, "docs", "performance.md")) as f:
+        doc = f.read()
+    m = re.search(
+        r'^(\{"metric": "resnet50_train_images_per_sec_per_chip".*\})$',
+        doc, re.M)
+    assert m, "committed live-capture JSON line missing from docs/performance.md"
+    captured = json.loads(m.group(1))
+
+    lkg = bench.LAST_KNOWN_GOOD
+    for key in ("metric", "value", "unit", "step_ms", "mfu", "vs_baseline"):
+        assert lkg[key] == captured[key], key
+    doc_extra = {r["metric"]: r for r in captured["extra"]}
+    for row in lkg["extra"]:
+        ref = doc_extra[row["metric"]]
+        for key in ("value", "step_ms", "mfu"):
+            assert row[key] == ref[key], (row["metric"], key)
