@@ -37,30 +37,44 @@ import time
 
 A100_RESNET50_IMG_PER_SEC = 2500.0
 
-# The most recent live capture committed to the repo (docs/performance.md,
-# "Committed live capture" section — v5e via the axon tunnel, 2026-07-31).
-# Emitted under "last_known_good" when the backend is unreachable so an
-# outage window still produces a self-explaining artifact instead of a
-# bare rc=3 (VERDICT r2 weak #7).
+# The most recent live captures committed to the repo (docs/performance.md
+# "Measurement variance" + `runs/tpu_window_0801_0802/` — v5e via the axon
+# tunnel). Emitted under "last_known_good" when the backend is unreachable
+# so an outage window still produces a self-explaining artifact instead of
+# a bare rc=3 (VERDICT r2 weak #7). Two live windows agree on the
+# flagship within 0.7% (2672.07 on 2026-07-31, 2652.85 on 2026-08-01);
+# the rows are a best-evidence composite — each row's note records which
+# window it came from and why (NOT always the freshest capture: a fresher
+# but contention-degraded reading does not replace a fresh-window one).
 LAST_KNOWN_GOOD = {
-    "captured": "2026-07-31",
-    "source": "docs/performance.md (builder-captured live run, rc=0, 402s)",
+    "captured": "2026-08-01",
+    "source": "runs/tpu_window_0801_0802/rerun_flagship.jsonl (verbatim "
+              "fresh-window re-runs, 48.25/48.27 ms) + bench.json (extra "
+              "rows) — contended captures read 10-20% low, see "
+              "docs/performance.md 'Measurement variance'",
     "metric": "resnet50_train_images_per_sec_per_chip",
-    "value": 2672.07,
+    "value": 2652.85,
     "unit": "images/sec/chip",
-    "step_ms": 47.9,
-    "mfu": 0.3243,
-    "vs_baseline": 1.0688,
+    "step_ms": 48.25,
+    "mfu": 0.322,
+    "vs_baseline": 1.0611,
     "extra": [
         {"metric": "arcface_resnet50_train_images_per_sec_per_chip",
          "value": 2542.49, "unit": "images/sec/chip", "step_ms": 50.34,
-         "mfu": 0.3086},
-        {"metric": "vit_s16_flash_train_images_per_sec_per_chip",
-         "value": 1892.05, "unit": "images/sec/chip", "step_ms": 67.65,
-         "mfu": 0.2443,
-         "note": "captured with the flash kernel forced (pre-auto-pick); "
-                 "the current bench emits vit_s16_dense_auto at 224px "
-                 "(196 tokens < flash_min_tokens)"},
+         "mfu": 0.3086,
+         "note": "fresh-window capture 2026-07-31 (the arcface bench path "
+                 "is unchanged since); the 2026-08-01 window re-read it "
+                 "at 2448.13 under the contention documented in "
+                 "docs/performance.md"},
+        {"metric": "vit_s16_dense_auto_train_images_per_sec_per_chip",
+         "value": 2020.06, "unit": "images/sec/chip", "step_ms": 63.36,
+         "mfu": 0.2832,
+         "note": "auto-pick took the dense path at 196 tokens, the "
+                 "measured-faster arm (ab_attention.json: dense 64.34 ms "
+                 "vs flash 67.10 ms); captured in the partially-contended "
+                 "2026-08-01 window (same run's flagship read 12.5% low), "
+                 "so a fresh-window value would read higher — this is the "
+                 "only capture of the auto-pick path so far"},
     ],
 }
 
@@ -93,6 +107,51 @@ def _flops_of(compiled) -> float | None:
             ca = ca[0]
         f = float(ca.get("flops", 0.0))
         return f if f > 0 else None
+    except Exception:
+        return None
+
+
+# Median time of the calibration probe (20 chained 4096³ bf16 matmuls in
+# one jit call) on the UNCONTENDED tunneled v5e. NOT YET CAPTURED — the
+# probe landed mid-contention on 2026-08-01 (81.57 ms, vs ~14 ms at v5e
+# bf16 peak / ~20 ms at realistic MXU efficiency), so this stays None
+# until a fresh uncontended window pins it; until then the JSON carries
+# the raw matmul20_ms and readers compare against the ~20 ms expectation.
+# The probe is framework-independent (pure XLA matmul), so probe_ms >>
+# reference in a capture means the chip/tunnel was contended, not that
+# the framework regressed (docs/performance.md "Measurement variance").
+PROBE_UNCONTENDED_MS = None  # becomes a float once captured on a fresh window
+
+
+def _contention_probe() -> float | None:
+    """Time a fixed reference computation (20 chained 4096x4096 bf16
+    matmuls, ~2.75 TFLOP per call — big enough to dwarf the ~1.6 ms tunnel
+    RPC floor) and return the median ms over 3 calls."""
+    import jax
+    import jax.numpy as jnp
+
+    try:
+        @jax.jit
+        def chain(a, b):
+            def body(c, _):
+                return a @ c, None
+            b, _ = jax.lax.scan(body, b, None, length=20)
+            return b
+
+        # a is scaled so a@b preserves b's magnitude — 20 iterations stay
+        # finite in bf16 and nothing can constant-fold away
+        a = jnp.full((4096, 4096), 1.0 / 4096, jnp.bfloat16)
+        b = jnp.ones((4096, 4096), jnp.bfloat16)
+        r = chain(a, b)
+        float(r[0, 0])  # hard sync past compile
+        times = []
+        for _ in range(3):
+            t0 = time.perf_counter()
+            r = chain(a, b)
+            float(r[0, 0])
+            times.append(time.perf_counter() - t0)
+        times.sort()
+        return round(times[1] * 1e3, 2)
     except Exception:
         return None
 
@@ -134,25 +193,87 @@ def _bench_row(cfg, mesh, *, steps: int, warmup: int, metric: str,
             float(metrics["loss"])  # device_get: hard sync (block_until_ready
             # does not reliably wait for remote/tunneled TPU execution)
 
-        t0 = time.perf_counter()
-        for _ in range(steps):
-            state, metrics = compiled(state, images, labels)
-        float(metrics["loss"])  # hard sync closes the timing window
-        dt = time.perf_counter() - t0
+        # Median-of-chunks timing: the tunneled backend shows a transient
+        # ~13% slowdown on the first row measured after backend init (live
+        # capture 2026-08-01: flagship 55.2 ms in the cold window vs 48.3 ms
+        # on immediate re-run — the stall outlived a 10-step warmup). One
+        # contiguous timing window folds that transient into the round's
+        # number; the median over 5 hard-synced chunks does not, while the
+        # per-chunk sync costs only ~1.6 ms RPC amortized over chunk_len
+        # steps (chunks are >= 5 steps, so <0.35 ms/step = <0.7% bias on a
+        # 50 ms step). 5 chunks whenever steps allow: an odd count gives a
+        # single true median element (an even count would need the middle
+        # pair's mean, half-counting a transient chunk).
+        n_chunks = min(5, max(steps // 5, 1))
+        chunk_len = steps // n_chunks
+        chunk_s = []
+        for c in range(n_chunks):
+            this_len = chunk_len + (steps % n_chunks if c == n_chunks - 1 else 0)
+            t0 = time.perf_counter()
+            for _ in range(this_len):
+                state, metrics = compiled(state, images, labels)
+            float(metrics["loss"])  # hard sync closes the timing window
+            chunk_s.append((time.perf_counter() - t0) / this_len)
 
-    step_s = dt / steps
+    chunk_s.sort()
+    mid = len(chunk_s) // 2
+    # true median: mean of the middle pair when the chunk count is even
+    # (picking the upper-middle would systematically report the WORSE
+    # chunk at n=2, reintroducing the transient this exists to absorb)
+    step_s = (chunk_s[mid] if len(chunk_s) % 2
+              else (chunk_s[mid - 1] + chunk_s[mid]) / 2)
     per_chip = batch / step_s / n_chips
     row = {
         "metric": metric,
         "value": round(per_chip, 2),
         "unit": "images/sec/chip",
         "step_ms": round(step_s * 1e3, 2),
+        "step_ms_spread": [round(chunk_s[0] * 1e3, 2), round(chunk_s[-1] * 1e3, 2)],
     }
     if flops is not None and peak is not None:
         # flops is per-device (SPMD-partitioned module) → divide by the
         # per-chip peak only
         row["mfu"] = round(flops / step_s / peak, 4)
     return row
+
+
+DEADLINE_GRACE_S = 120.0  # slack past --deadline before the watchdog fires
+
+
+def _arm_deadline_watchdog(deadline: float, t_start: float,
+                           partial_box: dict | None = None):
+    """Hard-bound the WHOLE bench run, not just backend init: a thread
+    stuck inside the tunneled plugin (lease churn mid-row — the hang can
+    strike any device sync, and it cannot be cancelled) would otherwise
+    burn the driver's window as an opaque rc=124. At deadline+grace this
+    prints the self-explaining fallback JSON line and exits 5 loudly.
+    Returns a disarm callback; no-op when deadline is 0/unset."""
+    import threading
+
+    if not deadline:
+        return lambda: None
+    done = threading.Event()
+
+    def watch():
+        budget = deadline + DEADLINE_GRACE_S - (time.monotonic() - t_start)
+        if not done.wait(max(budget, 1.0)):
+            payload = {
+                "backend": "hung_mid_run",
+                "error": f"bench exceeded --deadline {deadline:.0f}s + "
+                         f"{DEADLINE_GRACE_S:.0f}s grace (backend hang or "
+                         "extreme contention)",
+                "last_known_good": LAST_KNOWN_GOOD}
+            # an already-measured flagship row must not die with the
+            # process — a hung EXTRA row would otherwise discard it
+            if partial_box and "row" in partial_box:
+                payload["partial"] = partial_box["row"]
+            print(json.dumps(payload), flush=True)
+            print("# bench deadline watchdog fired; exiting 5", file=sys.stderr)
+            import os as _os
+            _os._exit(5)
+
+    threading.Thread(target=watch, daemon=True).start()
+    return done.set
 
 
 def main() -> None:
@@ -175,6 +296,10 @@ def main() -> None:
         if not args.deadline:
             return float("inf")
         return args.deadline - (time.monotonic() - t_start)
+
+    partial_box: dict = {}
+    disarm_deadline = _arm_deadline_watchdog(args.deadline, t_start,
+                                             partial_box)
 
     from ddp_classification_pytorch_tpu.utils.backend_probe import (
         backend_watchdog,
@@ -229,6 +354,19 @@ def main() -> None:
 
     mesh = meshlib.make_mesh(devices=devices)
 
+    probe = None
+    if platform == "tpu":
+        probe_ms = _contention_probe()
+        if probe_ms is not None:
+            probe = {"matmul20_ms": probe_ms,
+                     "uncontended_ms": PROBE_UNCONTENDED_MS}
+            if PROBE_UNCONTENDED_MS:
+                probe["contention_ratio"] = round(
+                    probe_ms / PROBE_UNCONTENDED_MS, 3)
+            print(f"# contention probe: {probe_ms} ms "
+                  f"(uncontended reference: {PROBE_UNCONTENDED_MS})",
+                  file=sys.stderr)
+
     cfg = get_preset("baseline")
     cfg.model.arch = args.arch
     cfg.model.dtype = "bfloat16" if on_accel else "float32"
@@ -246,6 +384,10 @@ def main() -> None:
         + ("" if on_accel else f"_{platform}"),
     )
     main_row["vs_baseline"] = round(main_row["value"] / A100_RESNET50_IMG_PER_SEC, 4)
+    # snapshot for the deadline watchdog: a hung EXTRA row must not discard
+    # the measured flagship (a copy — the watchdog serializes from its own
+    # thread, so it must not share a dict main_row later mutates)
+    partial_box["row"] = dict(main_row, **({"probe": probe} if probe else {}))
     print(
         f"# flagship: {platform} x{n_chips}, batch {cfg.data.batch_size}, "
         f"{cfg.data.image_size}px, {steps} steps, step {main_row['step_ms']}ms, "
@@ -308,6 +450,10 @@ def main() -> None:
                 + ("" if on_accel else f"_{platform}"),
             )
             extra.append(row)
+            # refresh the watchdog snapshot: completed extra rows must
+            # survive a later row's hang too (fresh copy — the watchdog
+            # serializes from its own thread)
+            partial_box["row"] = dict(partial_box["row"], extra=list(extra))
             print(f"# extra row {name}: {row['value']} img/s/chip, "
                   f"step {row['step_ms']}ms, mfu {row.get('mfu', 'n/a')}",
                   file=sys.stderr)
@@ -315,8 +461,11 @@ def main() -> None:
             print(f"# extra row {name!r} failed: {type(e).__name__}: {e}",
                   file=sys.stderr)
 
+    if probe:
+        main_row["probe"] = probe
     if extra:
         main_row["extra"] = extra
+    disarm_deadline()
     print(json.dumps(main_row), flush=True)
     print(
         f"# {platform} x{n_chips} ({devices[0].device_kind}), dtype "

@@ -14,7 +14,15 @@ echo "== 1/3 bench (the driver-comparable capture)" >&2
 python bench.py > "$out/bench.json" 2> "$out/bench.log"
 rc=$?
 tail -1 "$out/bench.json"
-[ $rc -ne 0 ] && echo "bench rc=$rc — backend likely down, stopping" >&2 && exit $rc
+if [ $rc -ne 0 ]; then
+  case $rc in
+    3) echo "bench rc=3 — backend unreachable (probe never answered), stopping" >&2 ;;
+    5) echo "bench rc=5 — backend answered but the run hung past its deadline" \
+            "(mid-run hang or extreme contention; see the fallback JSON)" >&2 ;;
+    *) echo "bench rc=$rc — unexpected failure, stopping" >&2 ;;
+  esac
+  exit $rc
+fi
 
 echo "== 2/3 dense-vs-flash A/B at bench token counts" >&2
 python scripts/ab_vit_attention.py --sizes 224,448 \

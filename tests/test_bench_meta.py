@@ -8,6 +8,8 @@ the two records drift apart silently (each looks authoritative).
 import json
 import os
 import re
+import subprocess
+import sys
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
@@ -27,7 +29,46 @@ def test_last_known_good_matches_committed_capture():
     for key in ("metric", "value", "unit", "step_ms", "mfu", "vs_baseline"):
         assert lkg[key] == captured[key], key
     doc_extra = {r["metric"]: r for r in captured["extra"]}
-    for row in lkg["extra"]:
-        ref = doc_extra[row["metric"]]
+    lkg_extra = {r["metric"]: r for r in lkg["extra"]}
+    # both directions: a row silently dropped from either side is drift too
+    assert set(doc_extra) == set(lkg_extra), (set(doc_extra), set(lkg_extra))
+    for metric, row in lkg_extra.items():
+        ref = doc_extra[metric]
         for key in ("value", "step_ms", "mfu"):
-            assert row[key] == ref[key], (row["metric"], key)
+            assert row[key] == ref[key], (metric, key)
+
+
+def test_deadline_watchdog_emits_fallback_and_exits_5():
+    """A bench run that outlives --deadline + grace must die LOUDLY with
+    the self-explaining fallback JSON on stdout (the mid-run-hang path; a
+    silent rc=124 from the driver's own timeout is the failure mode this
+    guards). Grace is shrunk via the module constant; the hang is a plain
+    sleep on the main thread — the watchdog must fire from its own."""
+    src = (
+        "import time, bench\n"
+        "bench.DEADLINE_GRACE_S = 0.2\n"
+        "bench._arm_deadline_watchdog(0.1, time.monotonic())\n"
+        "time.sleep(30)\n"
+    )
+    p = subprocess.run([sys.executable, "-c", src], cwd=REPO,
+                       capture_output=True, timeout=25)
+    assert p.returncode == 5, (p.returncode, p.stderr[-300:])
+    line = p.stdout.decode().strip().splitlines()[-1]
+    payload = json.loads(line)
+    assert payload["backend"] == "hung_mid_run"
+    assert payload["last_known_good"]["value"] == __import__("bench").LAST_KNOWN_GOOD["value"]
+
+
+def test_watchdog_disarm_prevents_exit():
+    src = (
+        "import time, bench\n"
+        "bench.DEADLINE_GRACE_S = 0.2\n"
+        "disarm = bench._arm_deadline_watchdog(0.1, time.monotonic())\n"
+        "disarm()\n"
+        "time.sleep(1.0)\n"
+        "print('survived')\n"
+    )
+    p = subprocess.run([sys.executable, "-c", src], cwd=REPO,
+                       capture_output=True, timeout=25)
+    assert p.returncode == 0, p.stderr[-300:]
+    assert b"survived" in p.stdout
