@@ -164,6 +164,12 @@ def build_parser() -> argparse.ArgumentParser:
                    help="capture a jax.profiler trace of N train steps")
     r.add_argument("--debug_nans", action="store_true",
                    help="enable jax_debug_nans (fail fast on NaN)")
+    r.add_argument("--hang_timeout_s", type=float, default=0.0,
+                   help="mid-run hang watchdog: exit 7 when no host-observed "
+                        "progress lands for this many seconds, so "
+                        "supervise.sh + --auto_resume can recover (0 = off; "
+                        "set WELL above the slowest compile — 900+ for "
+                        "tunneled TPU, more for TResNet)")
     r.add_argument("--grad_accum", type=int, default=0,
                    help="microbatch accumulation factor")
     r.add_argument("--platform", default="", choices=["", "tpu", "cpu"],
@@ -321,6 +327,8 @@ def config_from_args(args: argparse.Namespace) -> Config:
         cfg.run.profile_steps = args.profile_steps
     if args.debug_nans:
         cfg.run.debug_nans = True
+    if args.hang_timeout_s:
+        cfg.run.hang_timeout_s = args.hang_timeout_s
     if args.grad_accum:
         cfg.parallel.grad_accum = args.grad_accum
 

@@ -228,6 +228,16 @@ class RunConfig:
     profile_steps: int = 0  # >0: capture a jax.profiler trace of steps [10, 10+N)
     profile_dir: str = ""   # default: <out_dir>/profile
     debug_nans: bool = False  # jax_debug_nans for fail-fast numeric debugging
+    # mid-run hang detection (observed live 2026-08-01: a tunnel lease churn
+    # froze a training process mid-step FOREVER — zero CPU, no exception; a
+    # hang never exits, so supervise.sh alone cannot recover it). >0 arms a
+    # heartbeat watchdog: if no host-observed progress (log-line sync,
+    # epoch-end sync, eval sync, final-drain start) lands for this many
+    # seconds, the process exits
+    # loudly (code 7) so supervise.sh + auto_resume can take over. Set WELL
+    # above the slowest legitimate gap — first compile on a tunneled TPU can
+    # take 10+ min (TResNet); 0 disables.
+    hang_timeout_s: float = 0.0
 
 
 @dataclass

@@ -40,6 +40,7 @@ from ..data.synthetic import SyntheticDataset
 from ..data.transforms import build_transform
 from ..ops.nested import best_k
 from ..parallel import mesh as meshlib
+from ..utils.backend_probe import StepHeartbeat
 from ..utils.logging import EtaLogger, RecordWriter, host0_print, is_host0
 from .checkpoint import CheckpointManager
 from .state import create_train_state, param_count
@@ -139,6 +140,15 @@ class Trainer:
         mesh: Optional[Any] = None,
     ):
         self.cfg = cfg
+        # mid-run hang detector (inert at the default hang_timeout_s=0):
+        # armed FIRST — mesh/loader/state construction below already does
+        # real backend work (param placement), and the CLI's init watchdog
+        # is disarmed before the Trainer is built, so arming any later
+        # would leave exactly the hang window this exists to close. The
+        # timeout must exceed the slowest legitimate silent stretch (first
+        # compile included — see RunConfig.hang_timeout_s).
+        self._heartbeat = StepHeartbeat(
+            cfg.run.hang_timeout_s, where=f"trainer[{cfg.workload}]").start()
         if train_ds is None:
             train_ds, val_ds = build_datasets(cfg)
         self.train_ds, self.val_ds = train_ds, val_ds
@@ -272,9 +282,14 @@ class Trainer:
                 # the only host sync per log_every steps (reference syncs
                 # .item() on the same cadence, BASELINE/main.py:284-303)
                 eta.maybe_log(epoch, step, **{k: float(v) for k, v in metrics.items()})
+                # the float() above is a real device round-trip, so reaching
+                # here is proof the backend is answering — heartbeat it
+                self._heartbeat.touch()
         if sums is None:
             return {"loss": 0.0, "top1": 0.0, "top3": 0.0}
-        return {k: float(v) / n_batches for k, v in sums.items()}
+        out = {k: float(v) / n_batches for k, v in sums.items()}  # host sync
+        self._heartbeat.touch()
+        return out
 
     # ----------------------------------------------------------------- eval --
     def evaluate(self) -> Dict[str, float]:
@@ -291,6 +306,7 @@ class Trainer:
         if totals is None:
             return {"val_loss": 0.0, "val_top1": 0.0, "val_top3": 0.0}
         totals = {k: float(v) for k, v in totals.items()}  # the one host sync
+        self._heartbeat.touch()  # that sync proves the backend is answering
         n = max(totals["n"], 1.0)
         return {
             "val_loss": totals["loss_sum"] / n,
@@ -309,7 +325,8 @@ class Trainer:
             n_dev = out["n"] if n_dev is None else n_dev + out["n"]
         if t1 is None:  # val set smaller than one global batch
             return {"val_top1": 0.0, "val_top3": 0.0, "best_k": 0}
-        n = float(n_dev)
+        n = float(n_dev)  # host sync
+        self._heartbeat.touch()
         acc, k = best_k(t1, np.float32(max(n, 1.0)))
         return {
             "val_top1": float(acc),
@@ -344,7 +361,14 @@ class Trainer:
             metric = val_m.get("val_top1")
             self.ckpt.save(self.state, epoch, metric=metric,
                            **({"best_k": val_m["best_k"]} if "best_k" in val_m else {}))
+        # the drain below can block on device_gets for an in-flight async
+        # save — that is backend work, so it stays under the heartbeat
+        # (writes are atomic, so a fire mid-drain cannot truncate; the
+        # supervisor's restart then auto-resumes into an already-complete
+        # run and exits cleanly)
+        self._heartbeat.touch()
         self.ckpt.wait()  # land any in-flight async checkpoint before returning
+        self._heartbeat.stop()
         if self.tb is not None:
             self.tb.close()
         return last

@@ -242,7 +242,9 @@ class PLCTrainer(Trainer):
                 self.ckpt._write_meta(plc_delta=float(self.delta))
                 np.save(os.path.join(self.cfg.run.out_dir, "plc_labels.npy"),
                         _dataset_labels(self.train_ds))
+        self._heartbeat.touch()  # the drain is backend work; keep it covered
         self.ckpt.wait()
+        self._heartbeat.stop()
         if self.tb is not None:
             self.tb.close()
         return last

@@ -2,15 +2,20 @@
 
 Observed live on the tunneled TPU plugin: `jax.devices()` can BLOCK
 indefinitely inside the plugin's lease poll — no exception ever surfaces,
-so in-process retry loops never fire and the caller hangs forever. Two
-failure shapes, two tools:
+so in-process retry loops never fire and the caller hangs forever. Three
+failure shapes, three tools:
 
 - `require_backend()` probes the backend in a SUBPROCESS (killable on
   timeout) with retries/backoff before the caller touches jax, raising a
   diagnostic RuntimeError when the backend never answers;
 - `backend_watchdog()` bounds the caller's own first backend init, for the
   window where a probe passes and the lease churns seconds later (the hung
-  thread cannot be cancelled, so the watchdog exits the process loudly).
+  thread cannot be cancelled, so the watchdog exits the process loudly);
+- `StepHeartbeat` covers everything AFTER init: a lease churn mid-run
+  freezes the process at its next device sync (observed live 2026-08-01),
+  and only sustained absence of progress distinguishes that from a slow
+  step — so the trainer marks progress and a watchdog thread converts
+  prolonged silence into a loud exit the supervisor can restart.
 
 Both honor an explicit JAX_PLATFORMS override even under a sitecustomize
 that pins the TPU plugin (env alone does not switch the platform — the
@@ -80,6 +85,53 @@ def require_backend(attempts: int = 8, probe_timeout: int = 150,
     raise RuntimeError(
         f"JAX backend unreachable after {attempts} probes ({last}) — "
         "refusing to hang the caller")
+
+
+class StepHeartbeat:
+    """Mid-run hang detector (the third failure shape, observed live: a
+    tunnel lease churn froze a trainer mid-step — zero CPU accumulation,
+    no exception, forever; `backend_watchdog` only bounds the FIRST init,
+    and supervise.sh only restarts on exit, which a hang never reaches).
+
+    `touch()` marks host-observed progress; a daemon thread exits the
+    process loudly (os._exit(exit_code), default 7) when no touch lands
+    within `timeout_s`. The diagnostic is printed-and-flushed BEFORE the
+    exit, but the exit CODE is the real contract — it is what
+    supervise.sh restarts on."""
+
+    def __init__(self, timeout_s: float, *, exit_code: int = 7,
+                 where: str = "trainer"):
+        self.timeout_s = float(timeout_s)
+        self.exit_code = exit_code
+        self.where = where
+        self._last = time.monotonic()
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+
+    def start(self) -> "StepHeartbeat":
+        if self.timeout_s > 0 and self._thread is None:
+            self._thread = threading.Thread(target=self._watch, daemon=True)
+            self._thread.start()
+        return self
+
+    def touch(self) -> None:
+        self._last = time.monotonic()
+
+    def stop(self) -> None:
+        self._stop.set()
+
+    def _watch(self) -> None:
+        poll = min(max(self.timeout_s / 4.0, 0.05), 30.0)
+        while not self._stop.wait(poll):
+            stale = time.monotonic() - self._last
+            if stale > self.timeout_s:
+                print(f"# {self.where}: no progress for {stale:.0f}s "
+                      f"(> hang_timeout_s={self.timeout_s:.0f}) — backend "
+                      "hang suspected; exiting "
+                      f"{self.exit_code} for the supervisor to restart "
+                      "(auto_resume continues from the last checkpoint)",
+                      file=sys.stderr, flush=True)
+                os._exit(self.exit_code)
 
 
 def backend_watchdog(seconds: int = 900) -> Callable[[], None]:

@@ -75,3 +75,8 @@ def test_freeze_bn_flag_pair():
     assert _cfg("nested").model.freeze_bn is True  # preset (train.py:529)
     assert _cfg("nested", "--no-freeze-bn").model.freeze_bn is False
     assert _cfg("baseline", "--freeze-bn").model.freeze_bn is True
+
+
+def test_hang_timeout_flag():
+    assert _cfg("baseline").run.hang_timeout_s == 0.0  # off by default
+    assert _cfg("baseline", "--hang_timeout_s", "900").run.hang_timeout_s == 900.0
