@@ -1,16 +1,29 @@
 #!/usr/bin/env bash
 # Everything owed to the live chip, in priority order, for the next
-# tunnel-up window (rounds 2-3 were fully eclipsed by outages). Each step
-# is independently committed evidence; a window that closes mid-list still
-# leaves the earlier artifacts on disk. Serialize TPU access: nothing else
-# may hold the lease while this runs (docs/operations.md).
+# tunnel-up window. Each step is independently committed evidence; a
+# window that closes mid-list still leaves the earlier artifacts on disk.
+# Serialize TPU access: nothing else may hold the lease while this runs
+# (docs/operations.md).
+#
+# 2026-08-01 window banked: bench rc=0 (flagship 2652.85 fresh / 2319.72
+# cold-first-row), T=196/784 attention A/B, and native-dataplane on-chip
+# convergence for RN18/RN50/TResNet-M/VGG19-BN. Still owed (in order):
+#   1. a FRESH-WINDOW bench early in the window — pins
+#      PROBE_UNCONTENDED_MS (bench.py) from the emitted probe.matmul20_ms
+#      when step_ms lands near 48, and gives the vit dense-auto row its
+#      first uncontended capture
+#   2. a ViT digits run (the one model family without an on-chip
+#      convergence record)
+#   3. anything this file previously captured, re-run only if its code
+#      path changed since the banked artifact
 #
 # Usage: bash scripts/tpu_up_worklist.sh [outdir]
 set -u
 out=${1:-runs/tpu_window_$(date +%m%d_%H%M)}
 mkdir -p "$out"
 
-echo "== 1/3 bench (the driver-comparable capture)" >&2
+echo "== 1/3 bench (run FIRST: fresh-window numbers are the real ones —" >&2
+echo "   docs/performance.md 'Measurement variance')" >&2
 python bench.py > "$out/bench.json" 2> "$out/bench.log"
 rc=$?
 tail -1 "$out/bench.json"
@@ -23,21 +36,23 @@ if [ $rc -ne 0 ]; then
   esac
   exit $rc
 fi
+echo ">> if step_ms is ~48 and probe.matmul20_ms is fresh, pin" >&2
+echo ">> PROBE_UNCONTENDED_MS in bench.py to that probe value (and mirror" >&2
+echo ">> the capture into docs/performance.md — tests/test_bench_meta.py" >&2
+echo ">> locks the two together)" >&2
 
-echo "== 2/3 dense-vs-flash A/B at bench token counts" >&2
-python scripts/ab_vit_attention.py --sizes 224,448 \
-  > "$out/ab_attention.json" 2> "$out/ab_attention.log"
-cat "$out/ab_attention.json"
-
-echo "== 3/3 native-dataplane digits run on the chip (~5 min)" >&2
+echo "== 2/3 ViT digits run (last family without an on-chip record)" >&2
 python scripts/export_digits.py --root /tmp/digits
 python -m ddp_classification_pytorch_tpu.cli.train baseline \
-  --folder /tmp/digits --transform baseline --image_size 32 --crop_size 32 \
-  --variant cifar --model resnet18 --num_classes 10 --batchsize 128 \
-  --lr 0.1 --weight_decay 0.0005 --warmUpIter 36 --epochs 40 \
-  --lrSchedule 20 32 --out "$out/digits_rn18_native_tpu" --seed 999 \
-  --save_best_only 2>&1 | tail -3
-cat "$out/digits_rn18_native_tpu/meta.json" 2>/dev/null
+  --folder /tmp/digits --transform baseline --image_size 64 --crop_size 64 \
+  --model vit_t16 --num_classes 10 --batchsize 128 \
+  --lr 0.005 --weight_decay 0.0005 --warmUpIter 60 --epochs 40 \
+  --lrSchedule 20 32 --out "$out/digits_vit_native_tpu" --seed 999 \
+  --save_best_only --hang_timeout_s 1200 2>&1 | tail -3
+cat "$out/digits_vit_native_tpu/meta.json" 2>/dev/null
 
-echo "window work complete — commit $out (bench.json, ab_attention.json," >&2
-echo "digits record) and fold the A/B crossover into flash_min_tokens" >&2
+echo "== 3/3 dense-vs-flash A/B (re-run ONLY if the attention dispatch" >&2
+echo "   changed since runs/tpu_window_0801_0802/ab_attention.json)" >&2
+echo "   python scripts/ab_vit_attention.py --sizes 224,448" >&2
+
+echo "window work complete — git add -f the $out artifacts" >&2
