@@ -55,4 +55,9 @@ echo "== 3/3 dense-vs-flash A/B (re-run ONLY if the attention dispatch" >&2
 echo "   changed since runs/tpu_window_0801_0802/ab_attention.json)" >&2
 echo "   python scripts/ab_vit_attention.py --sizes 224,448" >&2
 
+# Optional: finish the hang-truncated VGG run (epochs 22-39; its workspace
+# checkpoint survives under runs/tpu_window_0801_0802/digits_vgg19bn_native_tpu
+# if this is the same workspace). Re-issue the original command with
+# --auto_resume --hang_timeout_s 1200; it continues from ckpt_best (epoch 21).
+
 echo "window work complete — git add -f the $out artifacts" >&2
