@@ -7,14 +7,16 @@
 #
 # 2026-08-01 window banked: bench rc=0 (flagship 2652.85 fresh / 2319.72
 # cold-first-row), T=196/784 attention A/B, and native-dataplane on-chip
-# convergence for RN18/RN50/TResNet-M/VGG19-BN. Still owed (in order):
+# convergence for RN18/RN50/TResNet-M/VGG19-BN.
+# 2026-08-02 window banked: two contended bench captures (probe 141.63 →
+# 95.04 ms as co-tenant load decayed — variance doc updated) and the ViT
+# on-chip convergence record (0.800 best val top-1, equal to the CPU-mesh
+# run). Still owed (in order):
 #   1. a FRESH-WINDOW bench early in the window — pins
 #      PROBE_UNCONTENDED_MS (bench.py) from the emitted probe.matmul20_ms
 #      when step_ms lands near 48, and gives the vit dense-auto row its
 #      first uncontended capture
-#   2. a ViT digits run (the one model family without an on-chip
-#      convergence record)
-#   3. anything this file previously captured, re-run only if its code
+#   2. anything this file previously captured, re-run only if its code
 #      path changed since the banked artifact
 #
 # Usage: bash scripts/tpu_up_worklist.sh [outdir]
