@@ -122,6 +122,38 @@ def _flops_of(compiled) -> float | None:
 # the framework regressed (docs/performance.md "Measurement variance").
 PROBE_UNCONTENDED_MS = None  # becomes a float once captured on a fresh window
 
+# Fallback expectation while PROBE_UNCONTENDED_MS is unpinned: ~20 ms is
+# the probe at realistic MXU efficiency on a v5e (docs/performance.md).
+PROBE_EXPECTED_MS_FALLBACK = 20.0
+CONTENTION_RATIO_THRESHOLD = 2.0
+
+
+def _contention_annotation(probe_ms):
+    """When the framework-independent probe reads far above its uncontended
+    reference, the capture is chip/tunnel-contended, not a framework
+    regression — annotate the SUCCESS line so a low BENCH_r0N.json number
+    explains itself (the outage paths already carry last_known_good; a
+    contended rc=0 otherwise looks like a silent regression). Returns None
+    on a fresh-window reading."""
+    if probe_ms is None:
+        return None
+    expected = PROBE_UNCONTENDED_MS or PROBE_EXPECTED_MS_FALLBACK
+    ratio = probe_ms / expected
+    if ratio < CONTENTION_RATIO_THRESHOLD:
+        return None
+    return {
+        "probe_ms": probe_ms,
+        "expected_ms": expected,
+        "ratio": round(ratio, 2),
+        "note": "probe (fixed XLA matmul chain, framework-independent) "
+                f"read {ratio:.1f}x its uncontended reference — the shared "
+                "tunneled chip was externally loaded during this capture; "
+                "values read 10-20%+ low (docs/performance.md 'Measurement "
+                "variance'). last_known_good is the freshest committed "
+                "fresh-window capture.",
+        "last_known_good": LAST_KNOWN_GOOD,
+    }
+
 
 def _contention_probe() -> float | None:
     """Time a fixed reference computation (20 chained 4096x4096 bf16
@@ -355,14 +387,13 @@ def main() -> None:
     mesh = meshlib.make_mesh(devices=devices)
 
     probe = None
+    contention = None
     if platform == "tpu":
         probe_ms = _contention_probe()
         if probe_ms is not None:
             probe = {"matmul20_ms": probe_ms,
                      "uncontended_ms": PROBE_UNCONTENDED_MS}
-            if PROBE_UNCONTENDED_MS:
-                probe["contention_ratio"] = round(
-                    probe_ms / PROBE_UNCONTENDED_MS, 3)
+            contention = _contention_annotation(probe_ms)
             print(f"# contention probe: {probe_ms} ms "
                   f"(uncontended reference: {PROBE_UNCONTENDED_MS})",
                   file=sys.stderr)
@@ -387,7 +418,11 @@ def main() -> None:
     # snapshot for the deadline watchdog: a hung EXTRA row must not discard
     # the measured flagship (a copy — the watchdog serializes from its own
     # thread, so it must not share a dict main_row later mutates)
-    partial_box["row"] = dict(main_row, **({"probe": probe} if probe else {}))
+    partial_box["row"] = dict(
+        main_row,
+        **({"probe": probe} if probe else {}),
+        **({"contention": contention} if contention else {}),
+    )
     print(
         f"# flagship: {platform} x{n_chips}, batch {cfg.data.batch_size}, "
         f"{cfg.data.image_size}px, {steps} steps, step {main_row['step_ms']}ms, "
@@ -463,6 +498,8 @@ def main() -> None:
 
     if probe:
         main_row["probe"] = probe
+    if contention:
+        main_row["contention"] = contention
     if extra:
         main_row["extra"] = extra
     disarm_deadline()

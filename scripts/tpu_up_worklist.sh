@@ -24,7 +24,7 @@ set -u
 out=${1:-runs/tpu_window_$(date +%m%d_%H%M)}
 mkdir -p "$out"
 
-echo "== 1/3 bench (run FIRST: fresh-window numbers are the real ones —" >&2
+echo "== 1/2 bench (run FIRST: fresh-window numbers are the real ones —" >&2
 echo "   docs/performance.md 'Measurement variance')" >&2
 python bench.py > "$out/bench.json" 2> "$out/bench.log"
 rc=$?
@@ -43,23 +43,19 @@ echo ">> PROBE_UNCONTENDED_MS in bench.py to that probe value (and mirror" >&2
 echo ">> the capture into docs/performance.md — tests/test_bench_meta.py" >&2
 echo ">> locks the two together)" >&2
 
-echo "== 2/3 ViT digits run (last family without an on-chip record)" >&2
-python scripts/export_digits.py --root /tmp/digits
-python -m ddp_classification_pytorch_tpu.cli.train baseline \
-  --folder /tmp/digits --transform baseline --image_size 64 --crop_size 64 \
-  --model vit_t16 --num_classes 10 --batchsize 128 \
-  --lr 0.005 --weight_decay 0.0005 --warmUpIter 60 --epochs 40 \
-  --lrSchedule 20 32 --out "$out/digits_vit_native_tpu" --seed 999 \
-  --save_best_only --hang_timeout_s 1200 2>&1 | tail -3
-cat "$out/digits_vit_native_tpu/meta.json" 2>/dev/null
-
-echo "== 3/3 dense-vs-flash A/B (re-run ONLY if the attention dispatch" >&2
+echo "== 2/2 dense-vs-flash A/B (re-run ONLY if the attention dispatch" >&2
 echo "   changed since runs/tpu_window_0801_0802/ab_attention.json)" >&2
 echo "   python scripts/ab_vit_attention.py --sizes 224,448" >&2
 
-# Optional: finish the hang-truncated VGG run (epochs 22-39; its workspace
-# checkpoint survives under runs/tpu_window_0801_0802/digits_vgg19bn_native_tpu
-# if this is the same workspace). Re-issue the original command with
-# --auto_resume --hang_timeout_s 1200; it continues from ckpt_best (epoch 21).
+# Optional: supersede the hang-truncated VGG record (0.9803 at epoch
+# 29/40) with a complete 40-epoch run — the epoch-21 checkpoint did not
+# survive into this workspace, so it is a fresh run, not a resume:
+#   python scripts/export_digits.py --root /tmp/digits
+#   python -m ddp_classification_pytorch_tpu.cli.train baseline \
+#     --folder /tmp/digits --transform baseline --image_size 64 \
+#     --crop_size 64 --model vgg19_bn --num_classes 10 --batchsize 128 \
+#     --lr 0.005 --weight_decay 0.0005 --warmUpIter 60 --epochs 40 \
+#     --lrSchedule 20 32 --out "$out/digits_vgg19bn_native_tpu" \
+#     --seed 999 --save_best_only --hang_timeout_s 1200
 
 echo "window work complete — git add -f the $out artifacts" >&2

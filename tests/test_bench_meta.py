@@ -59,6 +59,23 @@ def test_deadline_watchdog_emits_fallback_and_exits_5():
     assert payload["last_known_good"]["value"] == __import__("bench").LAST_KNOWN_GOOD["value"]
 
 
+def test_contention_annotation_thresholds():
+    """A contended capture must carry the self-explaining annotation (with
+    last_known_good) and a fresh one must not — so a low-but-successful
+    BENCH_r0N.json never reads as a silent framework regression."""
+    import bench
+
+    assert bench._contention_annotation(None) is None
+    # fresh window: below 2x the expectation
+    expected = bench.PROBE_UNCONTENDED_MS or bench.PROBE_EXPECTED_MS_FALLBACK
+    assert bench._contention_annotation(expected * 1.5) is None
+    ann = bench._contention_annotation(expected * 4.7)
+    assert ann is not None
+    assert ann["ratio"] == 4.7
+    assert ann["last_known_good"]["value"] == bench.LAST_KNOWN_GOOD["value"]
+    assert "contended" in ann["note"] or "loaded" in ann["note"]
+
+
 def test_watchdog_disarm_prevents_exit():
     src = (
         "import time, bench\n"
