@@ -1,0 +1,33 @@
+#!/bin/bash
+# Poll the axon backend; on the first answering probe, run the owed TPU
+# work in priority order (bench FIRST — fresh-window numbers), then the
+# optional VGG full run. Serializes: this is the only TPU toucher.
+cd /root/repo
+out=runs/tpu_window_auto
+mkdir -p "$out"
+while true; do
+  if timeout 150 python - <<'EOF'
+from ddp_classification_pytorch_tpu.utils.backend_probe import require_backend
+require_backend(attempts=1, probe_timeout=120)
+EOF
+  then
+    echo "=== backend UP at $(date -u +%H:%M:%S) ===" >> "$out/catcher.log"
+    stamp=$(date +%H%M)
+    python bench.py > "$out/bench_$stamp.json" 2> "$out/bench_$stamp.log"
+    rc=$?
+    echo "bench rc=$rc" >> "$out/catcher.log"
+    if [ $rc -ne 0 ]; then sleep 300; continue; fi
+    python scripts/export_digits.py --root /tmp/digits >> "$out/catcher.log" 2>&1
+    python -m ddp_classification_pytorch_tpu.cli.train baseline \
+      --folder /tmp/digits --transform baseline --image_size 64 --crop_size 64 \
+      --model vgg19_bn --num_classes 10 --batchsize 128 \
+      --lr 0.005 --weight_decay 0.0005 --warmUpIter 60 --epochs 40 \
+      --lrSchedule 20 32 --out "$out/digits_vgg19bn_native_tpu" --seed 999 \
+      --save_best_only --auto_resume --hang_timeout_s 1200 \
+      > "$out/vgg_train.log" 2>&1
+    echo "vgg rc=$? done at $(date -u +%H:%M:%S)" >> "$out/catcher.log"
+    exit 0
+  fi
+  echo "down at $(date -u +%H:%M:%S)" >> "$out/catcher.log"
+  sleep 600
+done
