@@ -396,8 +396,16 @@ def main(argv: Optional[Sequence[str]] = None) -> None:
         try:
             require_backend(attempts=2, probe_timeout=120)
         except RuntimeError as e:
-            raise SystemExit(f"[trainer] TPU backend unreachable: {e} "
-                             "(pass --platform cpu to train on the host)")
+            import sys
+
+            # exit 3 = "backend unreachable", matching bench.py's code for
+            # the same condition — distinct from config errors (SystemExit
+            # messages → rc 1) so supervisors (window_catcher.sh) can tell
+            # an outage-shaped failure from a deterministic one
+            print(f"[trainer] TPU backend unreachable: {e} "
+                  "(pass --platform cpu to train on the host)",
+                  file=sys.stderr)
+            raise SystemExit(3)
         backend_up = backend_watchdog(600)
     if args.multihost:
         jax.distributed.initialize()

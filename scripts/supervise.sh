@@ -14,11 +14,27 @@ while true; do
   python -m ddp_classification_pytorch_tpu.cli.train "$@" --auto_resume
   rc=$?
   [ "$rc" -eq 0 ] && exit 0
+  # rc classification lives HERE, one level below any window scheduler:
+  # 1/2 are deterministic (config/usage) — restarting replays the same
+  # failure; 3 is "backend unreachable" (trainer and bench share the
+  # code), where an immediate restart just burns the probe budget — back
+  # off long enough for a tunnel blip to pass. Everything else (4 init
+  # watchdog, 7 mid-run hang, OOM/kill signals) restarts fast and
+  # auto-resumes from the newest checkpoint.
+  case "$rc" in
+    1|2)
+      echo "[supervise] rc=$rc is deterministic (config/usage error);" \
+           "not restarting" >&2
+      exit "$rc" ;;
+    3) backoff=${OUTAGE_BACKOFF_S:-300} ;;
+    *) backoff=2 ;;
+  esac
   n=$((n + 1))
   if [ "$n" -gt "$max" ]; then
     echo "[supervise] giving up after $n failures (last rc=$rc)" >&2
     exit "$rc"
   fi
-  echo "[supervise] trainer exited rc=$rc; restart $n/$max (auto-resume)" >&2
-  sleep 2
+  echo "[supervise] trainer exited rc=$rc; restart $n/$max (auto-resume," \
+       "${backoff}s backoff)" >&2
+  sleep "$backoff"
 done

@@ -48,14 +48,8 @@ echo "   changed since runs/tpu_window_0801_0802/ab_attention.json)" >&2
 echo "   python scripts/ab_vit_attention.py --sizes 224,448" >&2
 
 # Optional: supersede the hang-truncated VGG record (0.9803 at epoch
-# 29/40) with a complete 40-epoch run — the epoch-21 checkpoint did not
-# survive into this workspace, so it is a fresh run, not a resume:
-#   python scripts/export_digits.py --root /tmp/digits
-#   python -m ddp_classification_pytorch_tpu.cli.train baseline \
-#     --folder /tmp/digits --transform baseline --image_size 64 \
-#     --crop_size 64 --model vgg19_bn --num_classes 10 --batchsize 128 \
-#     --lr 0.005 --weight_decay 0.0005 --warmUpIter 60 --epochs 40 \
-#     --lrSchedule 20 32 --out "$out/digits_vgg19bn_native_tpu" \
-#     --seed 999 --save_best_only --hang_timeout_s 1200
+# 29/40) with a complete 40-epoch run: `bash scripts/vgg_record.sh "$out"`
+# (the single source of truth for that recipe; window_catcher.sh runs it
+# automatically after a banked bench).
 
 echo "window work complete — git add -f the $out artifacts" >&2
