@@ -11,7 +11,9 @@
 # 2026-08-02 window banked: two contended bench captures (probe 141.63 →
 # 95.04 ms as co-tenant load decayed — variance doc updated) and the ViT
 # on-chip convergence record (0.800 best val top-1, equal to the CPU-mesh
-# run). Still owed (in order):
+# run); the window was followed by a 10+ h outage — check
+# runs/tpu_window_auto/ for artifacts window_catcher.sh may have banked
+# unattended. Still owed (in order):
 #   1. a FRESH-WINDOW bench early in the window — pins
 #      PROBE_UNCONTENDED_MS (bench.py) from the emitted probe.matmul20_ms
 #      when step_ms lands near 48, and gives the vit dense-auto row its
