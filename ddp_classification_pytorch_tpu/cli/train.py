@@ -358,7 +358,7 @@ def config_from_args(args: argparse.Namespace) -> Config:
     if args.sharded_ce:
         cfg.parallel.arcface_sharded_ce = True
     if args.moe_aux_weight is not None and args.moe_aux_weight < 0:
-        raise SystemExit(
+        raise ValueError(
             f"--moe_aux_weight must be >= 0, got {args.moe_aux_weight}")
     if args.moe_experts:
         cfg.model.moe_experts = args.moe_experts
@@ -370,7 +370,19 @@ def config_from_args(args: argparse.Namespace) -> Config:
 
 def main(argv: Optional[Sequence[str]] = None) -> None:
     args = build_parser().parse_args(argv)
-    cfg = config_from_args(args)  # cheap config errors surface before any probe
+    try:
+        # cheap config errors surface before any probe, and exit 2 — the same
+        # code argparse uses for usage errors — so supervisors can tell a
+        # deterministic config failure (rc 2: restarting replays the bug)
+        # from an unhandled runtime exception (bare rc 1: transient
+        # XlaRuntimeError through the tunnel, OOM, dataloader IO — retryable
+        # with backoff under supervise.sh)
+        cfg = config_from_args(args)
+    except ValueError as e:
+        import sys
+
+        print(f"[trainer] config error: {e}", file=sys.stderr)
+        raise SystemExit(2) from None
     import jax
 
     if args.platform:

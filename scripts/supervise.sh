@@ -15,17 +15,22 @@ while true; do
   rc=$?
   [ "$rc" -eq 0 ] && exit 0
   # rc classification lives HERE, one level below any window scheduler:
-  # 1/2 are deterministic (config/usage) — restarting replays the same
-  # failure; 3 is "backend unreachable" (trainer and bench share the
-  # code), where an immediate restart just burns the probe budget — back
-  # off long enough for a tunnel blip to pass. Everything else (4 init
-  # watchdog, 7 mid-run hang, OOM/kill signals) restarts fast and
-  # auto-resumes from the newest checkpoint.
+  # 2 is deterministic (config/usage — the trainer maps its own config
+  # validation to SystemExit(2), same code argparse uses) — restarting
+  # replays the same failure; bare 1 is an UNHANDLED runtime exception
+  # (transient XlaRuntimeError via the tunnel, in-process OOM, dataloader
+  # IO) — retryable, but with a backoff so a crash loop doesn't spin;
+  # 3 is "backend unreachable" (trainer and bench share the code), where
+  # an immediate restart just burns the probe budget — back off long
+  # enough for a tunnel blip to pass. Everything else (4 init watchdog,
+  # 7 mid-run hang, kill signals) restarts fast and auto-resumes from
+  # the newest checkpoint.
   case "$rc" in
-    1|2)
+    2)
       echo "[supervise] rc=$rc is deterministic (config/usage error);" \
            "not restarting" >&2
       exit "$rc" ;;
+    1) backoff=${RUNTIME_BACKOFF_S:-30} ;;
     3) backoff=${OUTAGE_BACKOFF_S:-300} ;;
     *) backoff=2 ;;
   esac

@@ -8,34 +8,65 @@
 # instead of being wasted). Serializes TPU access: nothing else may
 # touch the chip while this runs (docs/operations.md).
 #
-# rc discipline: outage-shaped failures (probe down; worklist rc 3/5;
-# a supervised run that lost its backend) are retried on later windows,
-# bounded by WINDOWS_MAX; deterministic failures (any other worklist rc,
-# dataset-export rc 6) stop the catcher loudly — an unattended retry
-# loop must not relabel a real bug as a transient outage.
+# rc discipline: outage-shaped failures (probe timeout/unreachable;
+# worklist rc 3/4/5; a supervised run that lost its backend) are retried
+# on later windows, bounded by WINDOWS_MAX; deterministic failures stop
+# the catcher loudly — an unattended retry loop must not relabel a real
+# bug as a transient outage. That includes the PROBE itself: a timeout or
+# "backend unreachable" is an outage, but an ImportError / missing
+# python / broken venv (rc 126/127 or a non-outage traceback) would
+# otherwise loop every 10 min forever, so those stop loudly too.
+#
+# Each banked window is committed IMMEDIATELY (git add -f + commit) so an
+# unattended window can't be lost to a workspace reset.
 #
 # Usage: nohup bash scripts/window_catcher.sh & — progress in
 # runs/tpu_window_auto/catcher.log; exits 0 after the owed work lands.
 set -u
 cd "$(dirname "$0")/.." || exit 1
-out=runs/tpu_window_auto
+out=${CATCHER_OUT:-runs/tpu_window_auto}
 mkdir -p "$out"
 log="$out/catcher.log"
 attempts=0
 
+bank() {
+  # commit whatever this window banked right away; runs/ is gitignored so
+  # artifacts need add -f, and catcher.log is excluded (it churns every
+  # poll and is not evidence). Commit ONLY the window paths — an
+  # operator's pre-staged WIP must not be swept into an evidence commit.
+  if ! git add -f -- "$out" ':!**/catcher.log' >> "$log" 2>&1; then
+    echo "WARNING: git add failed for $out — window NOT banked; commit" \
+         "the artifacts by hand before any workspace reset" >> "$log"
+    return 1
+  fi
+  git reset -q -- "$log" >> "$log" 2>&1 || true
+  if ! git diff --cached --quiet -- "$out"; then
+    git commit -m "$1" -- "$out" ':!**/catcher.log' >> "$log" 2>&1 \
+      && echo "banked commit: $1" >> "$log" \
+      || echo "WARNING: commit failed — artifacts staged but unbanked" >> "$log"
+  fi
+}
+
 while true; do
   # probe diagnostics go to the log too: a broken import / dead venv must
-  # read differently from a real outage (review r3 finding)
-  if timeout 150 python - >> "$log" 2>&1 <<'EOF'
+  # read differently from a real outage (review r3 finding) — capture the
+  # chunk separately so it can be classified before appending
+  chunk=$(mktemp)
+  timeout 150 python - > "$chunk" 2>&1 <<'EOF'
 from ddp_classification_pytorch_tpu.utils.backend_probe import require_backend
 require_backend(attempts=1, probe_timeout=120)
 EOF
-  then
+  prc=$?
+  cat "$chunk" >> "$log"
+  if [ "$prc" -eq 0 ]; then
+    rm -f "$chunk"
     stamp=$(date +%m%d_%H%M)
     echo "=== backend UP at $stamp ===" >> "$log"
     bash scripts/tpu_up_worklist.sh "$out/window_$stamp" >> "$log" 2>&1
     wrc=$?
+    progressed=0
     if [ "$wrc" -eq 0 ]; then
+      bank "Bank unattended TPU window $stamp: bench artifacts"
       # forward-progress marker: output.txt gains a line per epoch, so a
       # window that advanced the run must not count against WINDOWS_MAX
       # (a 40-epoch record may legitimately span many interrupted windows)
@@ -44,17 +75,21 @@ EOF
       bash scripts/vgg_record.sh "$out" > "$out/vgg_train_$stamp.log" 2>&1
       vrc=$?
       after=$(stat -c %Y "$marker" 2>/dev/null || echo 0)
-      [ "$after" -gt "$before" ] && attempts=0
+      [ "$after" -gt "$before" ] && progressed=1
       echo "vgg_record rc=$vrc at $(date -u +%H:%M:%S)" >> "$log"
+      bank "Bank unattended TPU window $stamp: VGG record progress (rc=$vrc)"
       [ "$vrc" -eq 0 ] && exit 0
       case "$vrc" in
         # outage-shaped trainer exits only: 3 backend unreachable at
         # launch, 4 init watchdog, 7 mid-run hang, 137/143 killed
         # (docs/operations.md table) — checkpoints survive and the next
-        # window's vgg_record auto-resumes from them
+        # window's vgg_record auto-resumes from them. rc 1 is a runtime
+        # exception that supervise.sh already retried MAX_RESTARTS times
+        # with backoff — persistent, not outage-shaped.
         3|4|7|137|143) ;;
         *) echo "vgg_record rc=$vrc is not outage-shaped (rc 6 = dataset" \
-                "export, 1/2 = config/usage error); stopping" >> "$log"
+                "export, 2 = config/usage error, 1 = runtime exception" \
+                "persisting through supervised retries); stopping" >> "$log"
            exit "$vrc" ;;
       esac
     else
@@ -63,20 +98,47 @@ EOF
         # deadline, 137/143 killed — all outage-shaped
         3|4|5|137|143)
           echo "worklist rc=$wrc (backend outage/hang mid-window)" \
-               >> "$log" ;;
+               >> "$log"
+          bank "Bank unattended TPU window $stamp: partial (worklist rc=$wrc)" ;;
         *) echo "worklist rc=$wrc is not outage-shaped (bench bug or" \
                 "config error); stopping" >> "$log"
            exit "$wrc" ;;
       esac
     fi
-    attempts=$((attempts + 1))
+    if [ "$progressed" -eq 1 ]; then
+      attempts=0
+    else
+      attempts=$((attempts + 1))
+    fi
     if [ "$attempts" -ge "${WINDOWS_MAX:-8}" ]; then
       echo "giving up after $attempts half-banked windows" >> "$log"
       exit 1
     fi
-    sleep 300
+    sleep "${INTER_WINDOW_S:-300}"
     continue
   fi
-  echo "down at $(date -u +%H:%M:%S)" >> "$log"
-  sleep 600
+  # classify the DOWN probe: timeout (124 from `timeout`, or the probe's
+  # own in-process TimeoutExpired → RuntimeError "backend unreachable")
+  # is outage-shaped; anything else (127 missing python, 126 not
+  # executable, ImportError/ModuleNotFoundError traceback) is a broken
+  # harness and must stop loudly, not retry forever. Broken-harness
+  # patterns take PRECEDENCE: require_backend wraps the probe
+  # subprocess's stderr tail into its "backend unreachable" message, so
+  # a venv whose `import jax` dies reads as BOTH — and must stop.
+  if grep -qE "ImportError|ModuleNotFoundError|command not found" "$chunk"; then
+    rm -f "$chunk"
+    echo "probe failure contains a broken-harness signature (ImportError/" \
+         "missing command); stopping — see the traceback above" >> "$log"
+    exit "${prc:-1}"
+  fi
+  if [ "$prc" -eq 124 ] || grep -qE "backend unreachable|TimeoutExpired|ConnectionError|DEADLINE_EXCEEDED|UNAVAILABLE" "$chunk"; then
+    rm -f "$chunk"
+    echo "down at $(date -u +%H:%M:%S)" >> "$log"
+    sleep "${DOWN_POLL_S:-600}"
+  else
+    rm -f "$chunk"
+    echo "probe failed rc=$prc and the output is not outage-shaped" \
+         "(broken venv/import?); stopping — see the traceback above" >> "$log"
+    exit "$prc"
+  fi
 done

@@ -1,0 +1,165 @@
+"""Behavioral tests for the recovery chain's exit-code discipline.
+
+Round-3 advisor (medium): rc=1 used to mean BOTH a deterministic config
+error and any unhandled runtime exception, so `supervise.sh` stopped the
+whole chain on transient crashes (a tunneled XlaRuntimeError, in-process
+OOM, dataloader IO) that `--auto_resume` exists to absorb. The contract
+now is:
+
+- rc 2 — deterministic config/usage error (argparse uses 2; the trainer
+  maps its own config validation to SystemExit(2) BEFORE any backend
+  probe). supervise.sh stops immediately: restarting replays the bug.
+- bare rc 1 — unhandled runtime exception. Retryable with
+  ``RUNTIME_BACKOFF_S`` backoff (default 30 s).
+- rc 3 — backend unreachable, long ``OUTAGE_BACKOFF_S`` backoff.
+
+`window_catcher.sh` (advisor low): a failing PROBE is only retried when
+the failure is outage-shaped (timeout / "backend unreachable"); a broken
+venv (ImportError, rc 126/127) stops the catcher loudly instead of
+polling every 10 minutes forever.
+
+The supervise/catcher tests drive the real scripts with a stub `python`
+on PATH whose per-call exit codes come from ``FAKE_RCS`` — no backend,
+no sleeps (backoffs are env-zeroed), so the suite stays fast.
+"""
+
+import os
+import stat
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+STUB = """#!/usr/bin/env bash
+state="${FAKE_STATE:?}"
+n=$(cat "$state" 2>/dev/null || echo 0)
+n=$((n+1)); echo "$n" > "$state"
+[ -n "${FAKE_STDOUT:-}" ] && echo "$FAKE_STDOUT"
+rc=$(echo "${FAKE_RCS:?}" | tr ',' '\\n' | sed -n "${n}p")
+[ -z "$rc" ] && rc=$(echo "$FAKE_RCS" | tr ',' '\\n' | tail -1)
+exit "$rc"
+"""
+
+
+def _stub_env(tmp_path, rcs, stdout=""):
+    fakebin = tmp_path / "bin"
+    fakebin.mkdir(exist_ok=True)
+    stub = fakebin / "python"
+    stub.write_text(STUB)
+    stub.chmod(stub.stat().st_mode | stat.S_IXUSR)
+    env = dict(os.environ)
+    env["PATH"] = f"{fakebin}:{env['PATH']}"
+    env["FAKE_STATE"] = str(tmp_path / "calls")
+    env["FAKE_RCS"] = rcs
+    if stdout:
+        env["FAKE_STDOUT"] = stdout
+    return env
+
+
+def _calls(tmp_path):
+    return int((tmp_path / "calls").read_text())
+
+
+def test_supervise_retries_runtime_rc1(tmp_path):
+    """A transient runtime crash (bare rc 1) restarts with backoff."""
+    env = _stub_env(tmp_path, "1,0")
+    env["RUNTIME_BACKOFF_S"] = "0"
+    p = subprocess.run(
+        ["bash", os.path.join(REPO, "scripts", "supervise.sh"), "baseline"],
+        env=env, capture_output=True, text=True, timeout=30)
+    assert p.returncode == 0, p.stderr
+    assert _calls(tmp_path) == 2, "rc=1 must be retried, then succeed"
+    assert "restart 1/" in p.stderr
+
+
+def test_supervise_stops_on_config_rc2(tmp_path):
+    """A deterministic config/usage error must NOT be retried."""
+    env = _stub_env(tmp_path, "2,0")
+    p = subprocess.run(
+        ["bash", os.path.join(REPO, "scripts", "supervise.sh"), "baseline"],
+        env=env, capture_output=True, text=True, timeout=30)
+    assert p.returncode == 2, (p.returncode, p.stderr)
+    assert _calls(tmp_path) == 1, "rc=2 must stop without a restart"
+
+
+def test_supervise_gives_up_after_max_restarts(tmp_path):
+    env = _stub_env(tmp_path, "1,1,1")
+    env["RUNTIME_BACKOFF_S"] = "0"
+    env["MAX_RESTARTS"] = "2"
+    p = subprocess.run(
+        ["bash", os.path.join(REPO, "scripts", "supervise.sh"), "baseline"],
+        env=env, capture_output=True, text=True, timeout=30)
+    assert p.returncode == 1
+    assert _calls(tmp_path) == 3  # initial + 2 restarts
+    assert "giving up" in p.stderr
+
+
+def test_trainer_config_error_exits_2():
+    """Config validation exits 2 before any probe/backend work (and argparse
+    usage errors already exit 2), so supervisors see one deterministic code."""
+    p = subprocess.run(
+        [sys.executable, "-m", "ddp_classification_pytorch_tpu.cli.train",
+         "baseline", "--folder", "/tmp/nonexistent",
+         "--moe_experts", "4", "--moe_aux_weight", "-1"],
+        cwd=REPO, capture_output=True, text=True, timeout=120,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"})
+    assert p.returncode == 2, (p.returncode, p.stderr[-500:])
+    assert "config error" in p.stderr
+
+
+def test_catcher_stops_loudly_on_broken_probe(tmp_path):
+    """rc 127 (missing interpreter) / ImportError is a broken harness, not an
+    outage — the catcher must stop with that rc, not poll forever."""
+    env = _stub_env(tmp_path, "127",
+                    stdout="bash: python3: command not found")
+    env["CATCHER_OUT"] = str(tmp_path / "out")
+    env["DOWN_POLL_S"] = "0"
+    p = subprocess.run(
+        ["bash", os.path.join(REPO, "scripts", "window_catcher.sh")],
+        env=env, capture_output=True, text=True, timeout=30)
+    assert p.returncode == 127, (p.returncode, p.stderr)
+    log = (tmp_path / "out" / "catcher.log").read_text()
+    # "command not found" hits the broken-harness signature grep; a bare
+    # unexplained rc would hit the "not outage-shaped" fallback — both stop
+    assert "broken-harness signature" in log or "not outage-shaped" in log
+    assert _calls(tmp_path) == 1
+
+
+def test_catcher_stops_when_unreachable_wraps_import_error(tmp_path):
+    """require_backend wraps the probe subprocess's stderr into its 'backend
+    unreachable' message, so a venv whose `import jax` dies reads as BOTH
+    outage and broken harness — the broken-harness signature must win."""
+    env = _stub_env(
+        tmp_path, "1",
+        stdout=("RuntimeError: JAX backend unreachable after 1 probes "
+                "(CalledProcessError: ModuleNotFoundError: "
+                "No module named 'jax')"))
+    env["CATCHER_OUT"] = str(tmp_path / "out")
+    env["DOWN_POLL_S"] = "0"
+    p = subprocess.run(
+        ["bash", os.path.join(REPO, "scripts", "window_catcher.sh")],
+        env=env, capture_output=True, text=True, timeout=30)
+    assert p.returncode == 1, (p.returncode, p.stderr)
+    log = (tmp_path / "out" / "catcher.log").read_text()
+    assert "broken-harness signature" in log
+    assert _calls(tmp_path) == 1
+
+
+def test_catcher_retries_outage_shaped_probe(tmp_path):
+    """A probe that times out / reports "backend unreachable" keeps polling —
+    bounded here by killing the catcher after a few cycles."""
+    env = _stub_env(
+        tmp_path, "1",  # stub repeats its last rc forever
+        stdout="RuntimeError: JAX backend unreachable after 1 probes")
+    env["CATCHER_OUT"] = str(tmp_path / "out")
+    env["DOWN_POLL_S"] = "0"
+    try:
+        subprocess.run(
+            ["bash", os.path.join(REPO, "scripts", "window_catcher.sh")],
+            env=env, capture_output=True, text=True, timeout=3)
+        raise AssertionError("catcher stopped on an outage-shaped probe")
+    except subprocess.TimeoutExpired:
+        pass  # still polling — the desired behavior
+    log = (tmp_path / "out" / "catcher.log").read_text()
+    assert "down at" in log
+    assert _calls(tmp_path) >= 2
