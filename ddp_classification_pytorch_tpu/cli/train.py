@@ -73,6 +73,9 @@ def build_parser() -> argparse.ArgumentParser:
                         "--flash_attention uses XLA's fused dense attention "
                         "instead of the kernel (default 1024, the measured "
                         "v5e crossover region; 0 = kernel always)")
+    m.add_argument("--ln_bf16", action="store_true",
+                   help="ViT: LayerNorms in bf16 instead of f32 (bandwidth "
+                        "experiment; scripts/ab_vit_perf.py measures it)")
     m.add_argument("--variant", default="", help="imagenet | cifar stem")
     m.add_argument("--pretrained", action="store_true",
                    help="load converted torchvision weights")
@@ -186,6 +189,10 @@ def build_parser() -> argparse.ArgumentParser:
     par.add_argument("--pp_microbatches", type=int, default=0,
                      help="enable GPipe pipelining of the ViT block stack "
                           "over the model axis with N microbatches")
+    par.add_argument("--pp_stages", type=int, default=0,
+                     help="give the pipeline its OWN mesh axis with N "
+                          "stages (3-axis dp×tp×pp mesh), composing with "
+                          "--mp class-dim TP; devices = dp×mp×N")
     par.add_argument("--dcn_slices", type=int, default=0,
                      help="multi-slice pods: two-tier mesh with DP across "
                           "N DCN-connected slices, model axis on ICI")
@@ -255,6 +262,8 @@ def config_from_args(args: argparse.Namespace) -> Config:
         cfg.model.arch = args.model
     if args.flash_attention:
         cfg.model.flash_attention = True
+    if args.ln_bf16:
+        cfg.model.ln_bf16 = True
     if args.flash_min_tokens >= 0:
         cfg.model.flash_min_tokens = args.flash_min_tokens
     if args.variant:
@@ -353,6 +362,10 @@ def config_from_args(args: argparse.Namespace) -> Config:
         cfg.parallel.model_axis = args.mp
     if args.pp_microbatches:
         cfg.parallel.pipeline_microbatches = args.pp_microbatches
+    if args.pp_stages:
+        if not args.pp_microbatches:
+            raise ValueError("--pp_stages requires --pp_microbatches")
+        cfg.parallel.pipeline_stages = args.pp_stages
     if args.dcn_slices:
         cfg.parallel.dcn_slices = args.dcn_slices
     if args.sharded_ce:

@@ -102,6 +102,11 @@ class ModelConfig:
     # kernel's job is keeping the per-shard score tile unmaterialized, which
     # matters at any length.
     flash_min_tokens: int = 1024
+    # ViT only: run the LayerNorms in the compute dtype (bf16) instead of
+    # f32 — a bandwidth experiment for the HBM-bound ViT step (VERDICT r3
+    # #5; A/B harness scripts/ab_vit_perf.py). Off = the standard
+    # f32-LN recipe every convergence record uses.
+    ln_bf16: bool = False
 
 
 @dataclass
@@ -152,10 +157,16 @@ class ParallelConfig:
     # microbatching / grad accumulation (capability headroom; reference: none)
     grad_accum: int = 1
     # >0 enables GPipe pipeline parallelism for the ViT family: the block
-    # stack shards into model_axis stages and this many microbatches stream
-    # through them (ops/pipeline.py). The model axis serves one role per
-    # config: class-TP | ring-attention SP | PP.
+    # stack shards into stages and this many microbatches stream through
+    # them (ops/pipeline.py). With pipeline_stages=0 the stages live on the
+    # model axis (one role per config: class-TP | ring-attention SP | PP).
     pipeline_microbatches: int = 0
+    # >1 gives the pipeline its OWN mesh axis ('pipe', parallel/mesh.py)
+    # with this many stages, composing dp×tp×pp in one program: blocks
+    # stage-shard over 'pipe' while the model axis keeps class-dim TP
+    # (e.g. an arcface head via arcface_sharded_ce). Device count must
+    # equal data_axis × model_axis × pipeline_stages.
+    pipeline_stages: int = 0
     # multi-slice deployments: number of DCN-connected slices. >0 builds a
     # two-tier mesh (parallel/mesh.py::make_hybrid_mesh) — DP spans slices
     # (one DCN allreduce/step), model axis stays inside a slice on ICI.

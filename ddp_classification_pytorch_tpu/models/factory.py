@@ -78,6 +78,7 @@ def build_backbone(cfg: ModelConfig, num_classes: int = 0,
             seq_axis=seq, remat=cfg.remat, use_flash=cfg.flash_attention,
             moe_experts=cfg.moe_experts, moe_top_k=cfg.moe_top_k,
             moe_axis=moe_axis, flash_min_tokens=cfg.flash_min_tokens,
+            ln_bf16=cfg.ln_bf16,
         )
     raise ValueError(f"unknown arch {cfg.arch!r}")
 
@@ -133,8 +134,8 @@ def build_model(cfg: ModelConfig, num_classes: int,
                 mesh: Optional[Any] = None,
                 pipeline_microbatches: int = 0) -> Any:
     if pipeline_microbatches > 0:
-        from ..parallel.mesh import MODEL_AXIS
-        from .pipeline_vit import GPipeViT
+        from ..parallel.mesh import MODEL_AXIS, PIPE_AXIS
+        from .pipeline_vit import GPipeArcFaceViT, GPipeViT
 
         if cfg.arch not in _vit.VIT_CONFIGS:
             raise ValueError(
@@ -142,10 +143,6 @@ def build_model(cfg: ModelConfig, num_classes: int,
                 f"arch with a homogeneous block stack; got {cfg.arch!r}")
         if mesh is None:
             raise ValueError("pipeline parallelism requires a device mesh")
-        if cfg.head != "fc":
-            raise ValueError(
-                f"pipeline parallelism only supports head='fc' "
-                f"(got {cfg.head!r})")
         if cfg.dropout:
             raise ValueError(
                 "pipeline parallelism does not support dropout (the tick "
@@ -155,9 +152,28 @@ def build_model(cfg: ModelConfig, num_classes: int,
                 "pipeline parallelism and moe_experts both claim the model "
                 "axis — one role per config (drop --pp_microbatches or "
                 "--moe_experts)")
+        # a dedicated 'pipe' axis (3-axis mesh, --pp_stages) hosts the
+        # stage ring so the 'model' axis stays free for class-dim TP;
+        # legacy 2-axis meshes keep the one-role-per-config 'model' ring
+        pipe_axis = (PIPE_AXIS if dict(mesh.shape).get(PIPE_AXIS, 1) > 1
+                     else MODEL_AXIS)
+        if cfg.head == "arcface":
+            return GPipeArcFaceViT(
+                cfg.arch, num_classes, mesh, pipeline_microbatches,
+                dtype=jnp.dtype(cfg.dtype), axis_name=pipe_axis,
+                remat=cfg.remat,
+                embed_dims=(512, cfg.arc_embed_dim),
+                s=cfg.arc_s, m=cfg.arc_m, easy_margin=cfg.arc_easy_margin,
+                log_softmax_quirk=cfg.arc_log_softmax_quirk,
+                ln_bf16=cfg.ln_bf16)
+        if cfg.head != "fc":
+            raise ValueError(
+                f"pipeline parallelism supports head='fc' or 'arcface' "
+                f"(got {cfg.head!r})")
         return GPipeViT(
             cfg.arch, num_classes, mesh, pipeline_microbatches,
-            dtype=jnp.dtype(cfg.dtype), axis_name=MODEL_AXIS, remat=cfg.remat)
+            dtype=jnp.dtype(cfg.dtype), axis_name=pipe_axis, remat=cfg.remat,
+            ln_bf16=cfg.ln_bf16)
     if cfg.head == "fc":
         return ClassifierModel(build_backbone(cfg, num_classes, axis_name, mesh))
     if cfg.head == "arcface":

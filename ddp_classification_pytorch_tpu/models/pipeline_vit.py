@@ -30,20 +30,27 @@ from .vit import VIT_CONFIGS, Block
 
 
 class GPipeViT:
-    """ViT classifier with its block stack pipelined over the mesh."""
+    """ViT classifier with its block stack pipelined over the mesh.
+
+    num_classes=0 builds a headless backbone: `apply` returns the pooled
+    post-LN features instead of logits (no 'fc' params) — the composition
+    point for margin heads (GPipeArcFaceViT below)."""
 
     def __init__(self, arch: str, num_classes: int, mesh: Any,
                  microbatches: int, dtype: Any = jnp.bfloat16,
-                 axis_name: str = "model", remat: bool = False):
+                 axis_name: str = "model", remat: bool = False,
+                 ln_bf16: bool = False):
         self.patch, self.dim, self.depth, self.heads = VIT_CONFIGS[arch]
         self.num_classes = num_classes
         self.mesh = mesh
         self.microbatches = microbatches
         self.dtype = dtype
         self.axis_name = axis_name
+        self.ln_bf16 = ln_bf16
         # dropout stays 0 in the pipelined path: the tick loop would need
         # per-tick rng plumbing for no parity gain (reference has no ViT)
-        self._block = Block(self.dim, self.heads, dtype, 0.0, None, None)
+        self._block = Block(self.dim, self.heads, dtype, 0.0, None, None,
+                            ln_bf16=ln_bf16)
         apply_fn = lambda p, h: self._block.apply({"params": p}, h, True)  # noqa: E731
         self._block_apply = jax.checkpoint(apply_fn) if remat else apply_fn
 
@@ -69,10 +76,13 @@ class GPipeViT:
             "blocks": block_params,
             "ln_f": {"scale": jnp.ones((self.dim,), jnp.float32),
                      "bias": jnp.zeros((self.dim,), jnp.float32)},
-            "fc": {"kernel": scale(k_fc, (self.dim, self.num_classes),
-                                   jnp.float32),
-                   "bias": jnp.zeros((self.num_classes,), jnp.float32)},
         }
+        if self.num_classes:
+            params["fc"] = {
+                "kernel": scale(k_fc, (self.dim, self.num_classes),
+                                jnp.float32),
+                "bias": jnp.zeros((self.num_classes,), jnp.float32),
+            }
         return {"params": params}
 
     # ----------------------------------------------------------------- apply --
@@ -91,14 +101,78 @@ class GPipeViT:
         h = gpipe(self._block_apply, p["blocks"], h, mesh=self.mesh,
                   axis_name=self.axis_name, microbatches=self.microbatches)
 
-        # final LN in f32, token mean-pool, linear head (models/vit.py layout)
-        h32 = h.astype(jnp.float32)
+        # final LN (f32, or the compute dtype under ln_bf16 — same lever
+        # as models/vit.py), token mean-pool, linear head
+        ln_dt = self.dtype if self.ln_bf16 else jnp.float32
+        h32 = h.astype(ln_dt)
         mu = h32.mean(axis=-1, keepdims=True)
         var = ((h32 - mu) ** 2).mean(axis=-1, keepdims=True)
         h32 = (h32 - mu) * jax.lax.rsqrt(var + 1e-6)
-        h32 = h32 * p["ln_f"]["scale"] + p["ln_f"]["bias"]
-        feats = h32.mean(axis=1)
+        h32 = h32 * p["ln_f"]["scale"].astype(ln_dt) \
+            + p["ln_f"]["bias"].astype(ln_dt)
+        feats = h32.astype(jnp.float32).mean(axis=1)
+        if not self.num_classes:  # headless backbone: pooled features
+            if mutable is not None:
+                return feats, {}
+            return feats
         logits = feats @ p["fc"]["kernel"] + p["fc"]["bias"]
         if mutable is not None:
             return logits, {}
         return logits
+
+
+class GPipeArcFaceViT:
+    """Pipelined ViT backbone + ArcFace margin head — the dp×tp×pp
+    composition: block stack stage-sharded over the mesh 'pipe' axis
+    (ops/pipeline.py), margin weight class-sharded over 'model'
+    (partial-FC, ops/sharded_head.py), batch over 'data'.
+
+    Same duck-typed model contract as GPipeViT plus the ArcFace surface
+    train/steps.py expects: `apply(..., labels)` → margin logits (dense
+    path / eval scores when labels=None), `method="features"` → the
+    embedding the class-sharded CE consumes. The embedding/margin modules
+    are the SAME flax heads the ResNet ArcFace model uses (models/heads.py)
+    — one margin implementation across every backbone family."""
+
+    def __init__(self, arch: str, num_classes: int, mesh: Any,
+                 microbatches: int, dtype: Any = jnp.bfloat16,
+                 axis_name: str = "pipe", remat: bool = False,
+                 embed_dims: Any = (512, 256), s: float = 30.0,
+                 m: float = 0.5, easy_margin: bool = False,
+                 log_softmax_quirk: bool = False, ln_bf16: bool = False):
+        from .heads import ArcEmbedding, ArcMarginHead
+
+        self.backbone = GPipeViT(arch, 0, mesh, microbatches, dtype,
+                                 axis_name, remat, ln_bf16=ln_bf16)
+        self.embedding = ArcEmbedding(dims=tuple(embed_dims),
+                                      log_softmax_quirk=log_softmax_quirk)
+        self.margin = ArcMarginHead(
+            num_classes=num_classes, in_features=int(embed_dims[-1]),
+            s=s, m=m, easy_margin=easy_margin)
+
+    def init(self, rngs: Any, x: jnp.ndarray, labels: Any = None,
+             train: bool = False, **_: Any) -> Dict[str, Any]:
+        key = rngs["params"] if isinstance(rngs, dict) else rngs
+        k_bb, k_emb, k_margin = jax.random.split(key, 3)
+        bb = self.backbone.init(k_bb, x)["params"]
+        feat = jnp.zeros((1, self.backbone.dim), jnp.float32)
+        emb_p = self.embedding.init(k_emb, feat)["params"]
+        emb = jnp.zeros((1, int(self.embedding.dims[-1])), jnp.float32)
+        margin_p = self.margin.init(k_margin, emb, None)["params"]
+        return {"params": {"backbone": bb, "embedding": emb_p,
+                           "margin": margin_p}}
+
+    def apply(self, variables: Dict[str, Any], x: jnp.ndarray,
+              labels: Any = None, train: bool = True,
+              mutable: Optional[Any] = None, rngs: Optional[Any] = None,
+              method: Optional[str] = None, **_: Any):
+        p = variables["params"]
+        feats = self.backbone.apply({"params": p["backbone"]}, x, train=train)
+        emb = self.embedding.apply({"params": p["embedding"]}, feats)
+        if method == "features":
+            out = emb
+        else:
+            out = self.margin.apply({"params": p["margin"]}, emb, labels)
+        if mutable is not None:
+            return out, {}
+        return out

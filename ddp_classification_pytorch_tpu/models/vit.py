@@ -76,7 +76,12 @@ class Block(nn.Module):
     """Pre-LN transformer block: LN→MHA→res, LN→FFN→res. The FFN is either
     the standard MLP(4×, GELU) or, with `moe_experts` > 0, a dropless
     split-FFN mixture-of-experts (ops/moe.py) whose experts shard over the
-    mesh `moe_axis` — expert parallelism."""
+    mesh `moe_axis` — expert parallelism.
+
+    `ln_bf16` runs the LayerNorms in the block compute dtype instead of
+    f32 — a bandwidth experiment for the HBM-bound ViT step (VERDICT r3
+    #5; the bench-scale A/B lives in scripts/ab_vit_perf.py). Params stay
+    f32 either way; default remains the f32-LN recipe."""
 
     dim: int
     heads: int
@@ -89,14 +94,16 @@ class Block(nn.Module):
     moe_top_k: int = 2
     moe_axis: Optional[str] = None
     flash_min_tokens: int = 0
+    ln_bf16: bool = False
 
     @nn.compact
     def __call__(self, x: jnp.ndarray, train: bool = True) -> jnp.ndarray:
-        y = nn.LayerNorm(dtype=jnp.float32, name="ln1")(x).astype(self.dtype)
+        ln_dtype = self.dtype if self.ln_bf16 else jnp.float32
+        y = nn.LayerNorm(dtype=ln_dtype, name="ln1")(x).astype(self.dtype)
         x = x + MHA(self.dim, self.heads, self.dtype, self.mesh,
                     self.seq_axis, self.use_flash,
                     self.flash_min_tokens, name="attn")(y)
-        y = nn.LayerNorm(dtype=jnp.float32, name="ln2")(x).astype(self.dtype)
+        y = nn.LayerNorm(dtype=ln_dtype, name="ln2")(x).astype(self.dtype)
         if self.moe_experts > 0:
             from ..ops.moe import (
                 load_balance_loss,
@@ -172,6 +179,7 @@ class ViT(nn.Module):
     moe_top_k: int = 2
     moe_axis: Optional[str] = None
     flash_min_tokens: int = 0
+    ln_bf16: bool = False
 
     @nn.compact
     def __call__(self, x: jnp.ndarray, train: bool = True) -> jnp.ndarray:
@@ -185,14 +193,27 @@ class ViT(nn.Module):
                          nn.initializers.normal(stddev=0.02),
                          (1, h * w, self.dim), jnp.float32)
         x = x + pos.astype(self.dtype)
-        block_cls = nn.remat(Block, static_argnums=(2,)) if self.remat else Block
+        if self.remat:
+            # checkpoint the blocks but keep every matmul (dot) output
+            # saved: the ViT's recompute cost is dominated by its matmuls,
+            # so the checkpoint_dots policy trades ~all of the activation
+            # memory the elementwise/LN chains hold for near-zero extra
+            # FLOPs — the remat policy VERDICT r3 #5 asks to exercise.
+            import jax as _jax
+
+            block_cls = nn.remat(
+                Block, static_argnums=(2,),
+                policy=_jax.checkpoint_policies.checkpoint_dots)
+        else:
+            block_cls = Block
         for i in range(self.depth):
             x = block_cls(self.dim, self.heads, self.dtype, self.dropout,
                           self.mesh, self.seq_axis, self.use_flash,
                           self.moe_experts, self.moe_top_k, self.moe_axis,
-                          self.flash_min_tokens,
+                          self.flash_min_tokens, self.ln_bf16,
                           name=f"block{i}")(x, train)
-        x = nn.LayerNorm(dtype=jnp.float32, name="ln_final")(x)
+        x = nn.LayerNorm(dtype=self.dtype if self.ln_bf16 else jnp.float32,
+                         name="ln_final")(x)
         x = x.mean(axis=1)  # token mean-pool; shard-friendly (see module doc)
         x = x.astype(jnp.float32)
         if self.num_classes > 0:
@@ -205,11 +226,11 @@ def build_vit(arch: str, num_classes: int = 0, dtype: Any = jnp.bfloat16,
               seq_axis: Optional[str] = None, remat: bool = False,
               use_flash: bool = False, moe_experts: int = 0,
               moe_top_k: int = 2, moe_axis: Optional[str] = None,
-              flash_min_tokens: int = 0) -> ViT:
+              flash_min_tokens: int = 0, ln_bf16: bool = False) -> ViT:
     patch, dim, depth, heads = VIT_CONFIGS[arch]
     return ViT(patch=patch, dim=dim, depth=depth, heads=heads,
                num_classes=num_classes, dtype=dtype, dropout=dropout,
                mesh=mesh, seq_axis=seq_axis, remat=remat,
                use_flash=use_flash, moe_experts=moe_experts,
                moe_top_k=moe_top_k, moe_axis=moe_axis,
-               flash_min_tokens=flash_min_tokens)
+               flash_min_tokens=flash_min_tokens, ln_bf16=ln_bf16)

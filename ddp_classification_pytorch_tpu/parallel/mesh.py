@@ -29,42 +29,54 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 DATA_AXIS = "data"
 MODEL_AXIS = "model"
+PIPE_AXIS = "pipe"
 
 
 @dataclasses.dataclass(frozen=True)
 class MeshSpec:
-    """data_parallel=0 → all devices on the data axis."""
+    """data_parallel=0 → all devices on the data axis.
+
+    pipeline_parallel > 1 adds a third 'pipe' axis so GPipe stages can
+    compose with class-dim TP on 'model' (dp×tp×pp in one program) —
+    with the default of 1, meshes stay two-axis and every existing
+    sharding rule is unchanged. Axis order is (data, model, pipe):
+    'pipe' innermost keeps each stage ring on contiguous ICI neighbor
+    links, the latency-critical hop (one ppermute per pipeline tick)."""
 
     data_parallel: int = 0
     model_parallel: int = 1
+    pipeline_parallel: int = 1
 
-    def resolve(self, n_devices: int) -> tuple[int, int]:
+    def resolve(self, n_devices: int) -> tuple[int, int, int]:
         mp = max(self.model_parallel, 1)
-        dp = self.data_parallel or n_devices // mp
-        if dp * mp != n_devices:
+        pp = max(self.pipeline_parallel, 1)
+        dp = self.data_parallel or n_devices // (mp * pp)
+        if dp * mp * pp != n_devices:
             raise ValueError(
-                f"mesh {dp}×{mp} does not cover {n_devices} devices"
+                f"mesh {dp}×{mp}×{pp} does not cover {n_devices} devices"
             )
-        return dp, mp
+        return dp, mp, pp
 
 
 def make_mesh(spec: MeshSpec = MeshSpec(), devices: Optional[Sequence[Any]] = None) -> Mesh:
     devices = list(devices) if devices is not None else jax.devices()
-    dp, mp = spec.resolve(len(devices))
-    if mp > 1:
+    dp, mp, pp = spec.resolve(len(devices))
+    shape = (dp, mp, pp) if pp > 1 else (dp, mp)
+    axes = (DATA_AXIS, MODEL_AXIS, PIPE_AXIS) if pp > 1 else (DATA_AXIS, MODEL_AXIS)
+    if mp > 1 or pp > 1:
         # ICI-aware layout: contiguous (ring-neighbor) device groups on the
-        # model axis, so ppermute rings (ring attention, GPipe handoffs) and
-        # TP collectives ride ICI neighbor links instead of striding the
+        # model/pipe axes, so ppermute rings (ring attention, GPipe handoffs)
+        # and TP collectives ride ICI neighbor links instead of striding the
         # torus. Falls back to the trivial reshape off-TPU.
         try:
             from jax.experimental import mesh_utils
 
-            arr = mesh_utils.create_device_mesh((dp, mp), devices=devices)
-            return Mesh(arr, (DATA_AXIS, MODEL_AXIS))
+            arr = mesh_utils.create_device_mesh(shape, devices=devices)
+            return Mesh(arr, axes)
         except Exception:
             pass
-    arr = np.asarray(devices).reshape(dp, mp)
-    return Mesh(arr, (DATA_AXIS, MODEL_AXIS))
+    arr = np.asarray(devices).reshape(shape)
+    return Mesh(arr, axes)
 
 
 def make_hybrid_mesh(spec: MeshSpec = MeshSpec(), *,
@@ -84,6 +96,14 @@ def make_hybrid_mesh(spec: MeshSpec = MeshSpec(), *,
     jax.devices()' slice_index when present, else 1 → plain make_mesh).
     """
     devices = jax.devices()
+    if max(spec.pipeline_parallel, 1) > 1:
+        # the two-tier hybrid layout is (data, model) only; silently
+        # dropping the requested 'pipe' axis would hand back a different
+        # parallelism program than asked for
+        raise ValueError(
+            "dcn_slices does not compose with pipeline_stages yet: the "
+            "hybrid mesh is two-axis (data, model) — drop --pp_stages "
+            "(stages ride the model axis) or --dcn_slices")
     n_slices = dcn_data_parallel
     if not n_slices:
         slice_ids = {getattr(d, "slice_index", 0) for d in devices}
@@ -93,7 +113,7 @@ def make_hybrid_mesh(spec: MeshSpec = MeshSpec(), *,
     from jax.experimental import mesh_utils
 
     per_slice = len(devices) // n_slices
-    dp_ici, mp = MeshSpec(
+    dp_ici, mp, _ = MeshSpec(
         spec.data_parallel // n_slices if spec.data_parallel else 0,
         spec.model_parallel).resolve(per_slice)
     try:
@@ -143,7 +163,8 @@ def make_global_array(host_batch: Any, mesh: Mesh) -> Any:
 
 # -------------------------------------------------------------- parameters --
 
-def _spec_for_param(path: str, value: Any, model_axis_size: int) -> P:
+def _spec_for_param(path: str, value: Any, model_axis_size: int,
+                    pipe_axis_size: int = 1) -> P:
     """Sharding rule for one parameter.
 
     Everything is replicated under pure DP. With a >1 'model' axis, the wide
@@ -153,7 +174,18 @@ def _spec_for_param(path: str, value: Any, model_axis_size: int) -> P:
     This is the ArcFace-at-10⁶-identities headroom (SURVEY §5): the (B, C)
     logits then shard over 'model' and XLA turns softmax-CE into a
     psum-over-axis reduction.
+
+    GPipeViT stacked block params (leading dim = depth) shard over the
+    dedicated 'pipe' axis when the mesh has one (3-axis dp×tp×pp), else
+    over 'model' (the legacy 2-axis one-role-per-config layout).
     """
+    stage_axis, stage_size = (
+        (PIPE_AXIS, pipe_axis_size) if pipe_axis_size > 1
+        else (MODEL_AXIS, model_axis_size))
+    if ("['blocks']" in path and value.ndim >= 1 and stage_size > 1
+            and value.shape[0] % stage_size == 0):
+        # stacked block params (L, ...): depth dim → pipeline stages
+        return P(stage_axis)
     if model_axis_size <= 1:
         return P()
     if "margin" in path and path.endswith("weight']") and value.ndim == 2:
@@ -170,19 +202,17 @@ def _spec_for_param(path: str, value: Any, model_axis_size: int) -> P:
     if value.ndim == 2 and "kernel" in path and (
             "classifier" in path or "']['fc']" in path):
         return P(None, MODEL_AXIS)
-    if ("['blocks']" in path and value.ndim >= 1
-            and value.shape[0] % model_axis_size == 0):
-        # GPipeViT stacked block params (L, ...): depth dim → pipeline stages
-        return P(MODEL_AXIS)
     return P()
 
 
 def param_shardings(variables: Any, mesh: Mesh) -> Any:
     """NamedSharding pytree matching `variables` (params + batch_stats)."""
     mp = mesh.shape[MODEL_AXIS]
+    pp = dict(mesh.shape).get(PIPE_AXIS, 1)
     flat, treedef = jax.tree_util.tree_flatten_with_path(variables)
     specs = [
-        NamedSharding(mesh, _spec_for_param(jax.tree_util.keystr(path), value, mp))
+        NamedSharding(
+            mesh, _spec_for_param(jax.tree_util.keystr(path), value, mp, pp))
         for path, value in flat
     ]
     return jax.tree_util.tree_unflatten(treedef, specs)

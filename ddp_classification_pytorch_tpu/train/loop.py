@@ -153,10 +153,14 @@ class Trainer:
             train_ds, val_ds = build_datasets(cfg)
         self.train_ds, self.val_ds = train_ds, val_ds
 
-        spec = meshlib.MeshSpec(cfg.parallel.data_axis, cfg.parallel.model_axis)
+        spec = meshlib.MeshSpec(cfg.parallel.data_axis, cfg.parallel.model_axis,
+                                max(cfg.parallel.pipeline_stages, 1))
         if mesh is not None:
             self.mesh = mesh
         elif cfg.parallel.dcn_slices:
+            # make_hybrid_mesh rejects pipeline_parallel > 1 (two-axis
+            # layout only) — the spec is passed whole so that validation
+            # actually sees the requested stages
             self.mesh = meshlib.make_hybrid_mesh(
                 spec, dcn_data_parallel=cfg.parallel.dcn_slices)
         else:

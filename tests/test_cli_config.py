@@ -66,7 +66,9 @@ def test_unknown_transform_rejected_at_build():
 
 
 def test_moe_aux_weight_validation():
-    with pytest.raises(SystemExit):
+    # ValueError from config_from_args; cli.main maps it to SystemExit(2)
+    # (tests/test_recovery_rc_discipline.py pins the exit code)
+    with pytest.raises(ValueError, match="moe_aux_weight"):
         _cfg("baseline", "--model", "vit_t16", "--moe_experts", "4",
              "--moe_aux_weight", "-0.5")
 

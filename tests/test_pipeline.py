@@ -158,9 +158,19 @@ def test_pipeline_flag_rejects_unsupported_configs():
     with _pytest.raises(ValueError, match="requires a ViT"):
         build_model(cfg, 4, mesh=mesh, pipeline_microbatches=2)
     cfg.arch = "vit_t16"
-    cfg.head = "arcface"
-    with _pytest.raises(ValueError, match="head='fc'"):
+    cfg.head = "nested"
+    with _pytest.raises(ValueError, match="head='fc' or 'arcface'"):
         build_model(cfg, 4, mesh=mesh, pipeline_microbatches=2)
+    # arcface is SUPPORTED since r4 (GPipeArcFaceViT — the dp×tp×pp
+    # composition, tests/test_three_axis_pipeline.py)
+    cfg.head = "arcface"
+    from ddp_classification_pytorch_tpu.models.pipeline_vit import (
+        GPipeArcFaceViT,
+    )
+
+    assert isinstance(
+        build_model(cfg, 4, mesh=mesh, pipeline_microbatches=2),
+        GPipeArcFaceViT)
     cfg.head = "fc"
     cfg.dropout = 0.1
     with _pytest.raises(ValueError, match="dropout"):
