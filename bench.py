@@ -89,26 +89,55 @@ _PEAK_BF16 = (
     ("TPU v2", 46e12),
 )
 
+# Per-chip HBM bandwidth, bytes/s (public specs) — the roofline the
+# flagship step is argued to sit at (docs/performance.md "Where the
+# ceiling is"). Emitting achieved GB/s per row turns that argument into a
+# measurement (VERDICT r3 #3).
+_PEAK_HBM = (
+    ("TPU v6 lite", 1640e9),  # Trillium / v6e
+    ("TPU v5 lite", 819e9),   # v5e
+    ("TPU v5p", 2765e9),
+    ("TPU v4", 1228e9),
+    ("TPU v3", 900e9),
+    ("TPU v2", 700e9),
+)
 
-def _peak_flops(device_kind: str) -> float | None:
-    for prefix, peak in _PEAK_BF16:
+
+def _lookup_peak(table, device_kind: str) -> float | None:
+    for prefix, peak in table:
         if device_kind.startswith(prefix):
             return peak
     return None
 
 
-def _flops_of(compiled) -> float | None:
-    """PER-DEVICE FLOPs per execution from XLA's cost analysis (the analysis
-    runs on the SPMD-partitioned module, so sharded-out work is already
-    divided out); None when the backend does not report it."""
+def _peak_flops(device_kind: str) -> float | None:
+    return _lookup_peak(_PEAK_BF16, device_kind)
+
+
+def _peak_hbm(device_kind: str) -> float | None:
+    return _lookup_peak(_PEAK_HBM, device_kind)
+
+
+def _cost_of(compiled) -> tuple[float | None, float | None]:
+    """(flops, bytes_accessed) PER DEVICE per execution from XLA's cost
+    analysis (the analysis runs on the SPMD-partitioned module, so
+    sharded-out work is already divided out); None when the backend does
+    not report a counter. `bytes accessed` is XLA's post-fusion estimate
+    of operand+output traffic — the standard roofline proxy (it assumes
+    no inter-op cache reuse, so it slightly over-counts true HBM bytes)."""
     try:
         ca = compiled.cost_analysis()
         if isinstance(ca, (list, tuple)):
             ca = ca[0]
         f = float(ca.get("flops", 0.0))
-        return f if f > 0 else None
+        b = float(ca.get("bytes accessed", 0.0))
+        return (f if f > 0 else None), (b if b > 0 else None)
     except Exception:
-        return None
+        return None, None
+
+
+def _flops_of(compiled) -> float | None:
+    return _cost_of(compiled)[0]
 
 
 # Median time of the calibration probe (20 chained 4096³ bf16 matmuls in
@@ -189,7 +218,8 @@ def _contention_probe() -> float | None:
 
 
 def _bench_row(cfg, mesh, *, steps: int, warmup: int, metric: str,
-               n_chips: int, peak: float | None, seed: int = 0):
+               n_chips: int, peak: float | None,
+               peak_bw: float | None = None, seed: int = 0):
     """Compile (AOT, so cost analysis and execution share one compile),
     run warmup + timed steps on synthetic device-resident data, and return
     a row dict with images/sec/chip, step_ms and mfu."""
@@ -217,7 +247,7 @@ def _bench_row(cfg, mesh, *, steps: int, warmup: int, metric: str,
         )
 
         compiled = step.lower(state, images, labels).compile()
-        flops = _flops_of(compiled)
+        flops, bytes_accessed = _cost_of(compiled)
 
         for _ in range(warmup):
             state, metrics = compiled(state, images, labels)
@@ -266,6 +296,17 @@ def _bench_row(cfg, mesh, *, steps: int, warmup: int, metric: str,
         # flops is per-device (SPMD-partitioned module) → divide by the
         # per-chip peak only
         row["mfu"] = round(flops / step_s / peak, 4)
+    if bytes_accessed is not None:
+        # the roofline as a measurement: XLA's post-fusion bytes-accessed
+        # estimate over the measured step time. hbm_peak_frac ≳ 0.75 says
+        # the step is at the bandwidth wall (the estimate over-counts true
+        # traffic somewhat, so 1.0 is not reachable); well below that, the
+        # gap is schedule/compute, not bandwidth (docs/performance.md
+        # "Roofline, measured").
+        row["bytes_per_step_gb"] = round(bytes_accessed / 1e9, 2)
+        row["achieved_gbps"] = round(bytes_accessed / step_s / 1e9, 1)
+        if peak_bw is not None:
+            row["hbm_peak_frac"] = round(bytes_accessed / step_s / peak_bw, 4)
     return row
 
 
@@ -383,6 +424,7 @@ def main() -> None:
     platform = devices[0].platform
     on_accel = platform in ("tpu", "gpu")
     peak = _peak_flops(devices[0].device_kind) if platform == "tpu" else None
+    peak_bw = _peak_hbm(devices[0].device_kind) if platform == "tpu" else None
 
     mesh = meshlib.make_mesh(devices=devices)
 
@@ -411,6 +453,7 @@ def main() -> None:
 
     main_row = _bench_row(
         cfg, mesh, steps=steps, warmup=warmup, n_chips=n_chips, peak=peak,
+        peak_bw=peak_bw,
         metric=f"{args.arch}_train_images_per_sec_per_chip"
         + ("" if on_accel else f"_{platform}"),
     )
@@ -480,7 +523,7 @@ def main() -> None:
                 continue
             row = _bench_row(
                 c, row_mesh, steps=max(steps // 2, 1), warmup=max(warmup // 2, 1),
-                n_chips=n_chips, peak=peak,
+                n_chips=n_chips, peak=peak, peak_bw=peak_bw,
                 metric=f"{label}_train_images_per_sec_per_chip"
                 + ("" if on_accel else f"_{platform}"),
             )

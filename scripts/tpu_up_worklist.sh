@@ -16,8 +16,11 @@
 # unattended. Still owed (in order):
 #   1. a FRESH-WINDOW bench early in the window — pins
 #      PROBE_UNCONTENDED_MS (bench.py) from the emitted probe.matmul20_ms
-#      when step_ms lands near 48, and gives the vit dense-auto row its
-#      first uncontended capture
+#      when step_ms lands near 48, gives the vit dense-auto row its
+#      first uncontended capture, AND (new in r4) emits the measured
+#      roofline fields (bytes_per_step_gb / achieved_gbps /
+#      hbm_peak_frac — docs/performance.md "Roofline, measured": record
+#      the verdict there either way)
 #   2. anything this file previously captured, re-run only if its code
 #      path changed since the banked artifact
 #

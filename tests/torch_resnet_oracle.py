@@ -1,0 +1,14 @@
+"""Shim: the torch oracles moved into the package so `cli.verify_import`
+can run the same parity check against a real `.pth`
+(ddp_classification_pytorch_tpu/models/torch_oracle.py). Test imports
+keep their historical name."""
+
+from ddp_classification_pytorch_tpu.models.torch_oracle import (  # noqa: F401
+    TorchResNet,
+    TorchTResNetM,
+    TorchVGG19BN,
+    make_torch_resnet,
+    make_torch_tresnet_m,
+    make_torch_vgg19_bn,
+    randomize_,
+)
