@@ -82,3 +82,20 @@ def test_freeze_bn_flag_pair():
 def test_hang_timeout_flag():
     assert _cfg("baseline").run.hang_timeout_s == 0.0  # off by default
     assert _cfg("baseline", "--hang_timeout_s", "900").run.hang_timeout_s == 900.0
+
+
+def test_pp_stages_wiring():
+    cfg = _cfg("arcface", "--model", "vit_t16", "--dp", "2", "--mp", "2",
+               "--pp_stages", "2", "--pp_microbatches", "2")
+    assert cfg.parallel.pipeline_stages == 2
+    assert cfg.parallel.pipeline_microbatches == 2
+    # --pp_stages without microbatches is a config error (maps to exit 2
+    # in main(); tests/test_recovery_rc_discipline.py pins the code)
+    with pytest.raises(ValueError, match="pp_microbatches"):
+        _cfg("arcface", "--model", "vit_t16", "--pp_stages", "2")
+
+
+def test_ln_bf16_wiring():
+    assert _cfg("baseline").model.ln_bf16 is False
+    assert _cfg("baseline", "--model", "vit_s16",
+                "--ln_bf16").model.ln_bf16 is True
