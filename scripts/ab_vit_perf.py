@@ -36,13 +36,24 @@ import bench  # noqa: E402  (repo root — reuse probe, rows, peak tables)
 
 
 def main() -> None:
+    t_start = time.monotonic()
     ap = argparse.ArgumentParser()
     ap.add_argument("--steps", type=int, default=30)
     ap.add_argument("--warmup", type=int, default=5)
     ap.add_argument("--batch", type=int, default=0, help="0 = 128/chip")
     ap.add_argument("--image-size", type=int, default=224)
     ap.add_argument("--variants", default="baseline,ln_bf16,remat_dots,flash")
+    ap.add_argument("--deadline", type=float, default=900.0,
+                    help="wall-clock budget; a mid-run backend hang exits 5 "
+                         "(bench.py's deadline watchdog) instead of blocking "
+                         "the unattended window chain forever")
     args = ap.parse_args()
+
+    # same watchdog bench.main() arms: the tunneled backend can hang any
+    # device sync with no exception — unattended callers
+    # (tpu_up_worklist.sh → window_catcher.sh) need an exit, not a hang
+    partial_box: dict = {}
+    disarm = bench._arm_deadline_watchdog(args.deadline, t_start, partial_box)
 
     from ddp_classification_pytorch_tpu.utils.backend_probe import (
         backend_watchdog,
@@ -100,6 +111,7 @@ def main() -> None:
 
     steps = args.steps if on_accel else 2
     warmup = args.warmup if on_accel else 1
+    done_rows = []
     for variant in [v for v in args.variants.split(",") if v]:
         t0 = time.monotonic()
         row = bench._bench_row(
@@ -110,9 +122,14 @@ def main() -> None:
         if probe_ms is not None:
             row["probe_matmul20_ms"] = probe_ms
         print(json.dumps(row), flush=True)
+        # measured variants must survive a later variant's hang (the
+        # watchdog serializes this box from its own thread)
+        done_rows.append(dict(row))
+        partial_box["row"] = {"ab_vit_perf_rows": list(done_rows)}
         print(f"# {variant}: {row['value']} img/s/chip, "
               f"step {row['step_ms']}ms, mfu {row.get('mfu', 'n/a')}, "
               f"{time.monotonic() - t0:.0f}s", file=sys.stderr)
+    disarm()
 
 
 if __name__ == "__main__":

@@ -48,9 +48,29 @@ echo ">> PROBE_UNCONTENDED_MS in bench.py to that probe value (and mirror" >&2
 echo ">> the capture into docs/performance.md — tests/test_bench_meta.py" >&2
 echo ">> locks the two together)" >&2
 
-echo "== 2/2 dense-vs-flash A/B (re-run ONLY if the attention dispatch" >&2
-echo "   changed since runs/tpu_window_0801_0802/ab_attention.json)" >&2
-echo "   python scripts/ab_vit_attention.py --sizes 224,448" >&2
+echo "== 2/2 ViT perf A/B (VERDICT r4: baseline/ln_bf16/remat_dots/flash" >&2
+echo "   at bench shapes — decides ln_bf16's default and the vit row's" >&2
+echo "   0.2832-MFU chase; verdict goes into docs/performance.md)" >&2
+python scripts/ab_vit_perf.py > "$out/ab_vit_perf.jsonl" 2> "$out/ab_vit_perf.log"
+abrc=$?
+if [ $abrc -ne 0 ]; then
+  case $abrc in
+    # outage-shaped (docs/operations.md: 3 unreachable, 4 init-watchdog
+    # lease churn, 5 mid-run hang deadline, 137/143 killed): stop the
+    # window — the VGG record would fail the same way; anything else is
+    # an A/B bug — warn and continue, a broken experiment must not cost
+    # the queued convergence record
+    3|4|5|137|143) echo "ab_vit_perf rc=$abrc — backend outage, stopping" >&2
+                   exit $abrc ;;
+    *) echo "ab_vit_perf rc=$abrc (non-outage) — continuing to the" \
+            "VGG record; see $out/ab_vit_perf.log" >&2 ;;
+  esac
+fi
+tail -4 "$out/ab_vit_perf.jsonl" >&2
+
+echo "== (reference) dense-vs-flash A/B already banked:" >&2
+echo "   runs/tpu_window_0801_0802/ab_attention.json — re-run" >&2
+echo "   scripts/ab_vit_attention.py ONLY if the attention dispatch changed" >&2
 
 # Optional: supersede the hang-truncated VGG record (0.9803 at epoch
 # 29/40) with a complete 40-epoch run: `bash scripts/vgg_record.sh "$out"`

@@ -1,0 +1,77 @@
+"""A caught TPU window must end up COMMITTED (VERDICT r3 #7).
+
+`scripts/window_catcher.sh` banks bench + VGG artifacts unattended; round
+3 left them sitting uncommitted in the work tree, where a workspace reset
+could erase a scarce capture. The catcher now git-commits each banked
+window immediately — this test drives the REAL catcher + worklist +
+vgg_record + supervise chain in a scratch git repo (so no test commits
+ever touch the real history), with a scripted stub interpreter standing
+in for python (same technique as tests/test_recovery_rc_discipline.py):
+probe answers, "bench" succeeds, "training" succeeds, and the assertions
+are about git state — two bank commits exist, they contain the window
+artifacts, catcher.log stays untracked, and pre-staged operator WIP is
+NOT swept into the evidence commits.
+"""
+
+import os
+import shutil
+import stat
+import subprocess
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+STUB = """#!/usr/bin/env bash
+echo "stub-json-line"
+exit 0
+"""
+
+
+def _git(cwd, *args):
+    return subprocess.run(["git", "-C", str(cwd)] + list(args),
+                          capture_output=True, text=True, check=True).stdout
+
+
+def test_caught_window_is_committed_and_scoped(tmp_path):
+    scratch = tmp_path / "scratch_repo"
+    scratch.mkdir()
+    shutil.copytree(os.path.join(REPO, "scripts"), scratch / "scripts")
+    (scratch / ".gitignore").write_text("runs/\n")
+    subprocess.run(["git", "init", "-q"], cwd=scratch, check=True)
+    _git(scratch, "config", "user.email", "t@t")
+    _git(scratch, "config", "user.name", "t")
+    _git(scratch, "add", "-A")
+    _git(scratch, "commit", "-qm", "init")
+
+    # operator WIP staged before the window opens — must survive untouched
+    wip = scratch / "wip.py"
+    wip.write_text("# half-finished\n")
+    _git(scratch, "add", "wip.py")
+
+    fakebin = tmp_path / "bin"
+    fakebin.mkdir()
+    stub = fakebin / "python"
+    stub.write_text(STUB)
+    stub.chmod(stub.stat().st_mode | stat.S_IXUSR)
+
+    env = dict(os.environ)
+    env["PATH"] = f"{fakebin}:{env['PATH']}"
+    env["DOWN_POLL_S"] = "0"
+    env["INTER_WINDOW_S"] = "0"
+    p = subprocess.run(
+        ["bash", str(scratch / "scripts" / "window_catcher.sh")],
+        env=env, capture_output=True, text=True, timeout=60)
+    assert p.returncode == 0, (p.stdout, p.stderr)
+
+    log = _git(scratch, "log", "--format=%s")
+    bank_commits = [s for s in log.splitlines()
+                    if s.startswith("Bank unattended TPU window")]
+    assert len(bank_commits) == 2, log  # bench bank + VGG bank
+
+    # the bench artifact is in a bank commit; catcher.log never tracked
+    tracked = _git(scratch, "ls-files")
+    assert "bench.json" in tracked
+    assert "catcher.log" not in tracked
+
+    # operator WIP: still staged, never committed
+    assert "wip.py" not in _git(scratch, "log", "--name-only")
+    assert "wip.py" in _git(scratch, "diff", "--cached", "--name-only")
