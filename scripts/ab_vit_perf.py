@@ -112,7 +112,18 @@ def main() -> None:
     steps = args.steps if on_accel else 2
     warmup = args.warmup if on_accel else 1
     done_rows = []
+    # same guard bench.main() applies to its extra rows: a variant only
+    # STARTS while enough budget remains for its compile+measure, so the
+    # deadline watchdog firing genuinely means "backend hung", never
+    # "list too long on a slow-but-healthy window"
+    variant_budget = 240.0
     for variant in [v for v in args.variants.split(",") if v]:
+        left = (args.deadline - (time.monotonic() - t_start)
+                if args.deadline else float("inf"))
+        if left < variant_budget:
+            print(f"# skipping variant {variant!r}: {left:.0f}s left < "
+                  f"{variant_budget:.0f}s budget", file=sys.stderr)
+            continue
         t0 = time.monotonic()
         row = bench._bench_row(
             cfg_for(variant), mesh, steps=steps, warmup=warmup,
