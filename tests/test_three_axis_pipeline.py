@@ -16,6 +16,7 @@ float reduction order.
 """
 
 import jax
+import jax.numpy as jnp
 import numpy as np
 import pytest
 
@@ -82,3 +83,29 @@ def test_dp_tp_pp_composes_and_matches_single_axis():
     mesh1 = meshlib.make_mesh(meshlib.MeshSpec(8, 1, 1), jax.devices()[:8])
     losses1, _ = _losses(mesh1, mp=1, pp=1)
     np.testing.assert_allclose(losses3, losses1, rtol=5e-4, atol=1e-5)
+
+
+@pytest.mark.skipif(len(jax.devices()) < 8, reason="needs 8 devices")
+def test_gpipe_arcface_inference_scores_match_dense_head():
+    """GPipeArcFaceViT's labels=None path must produce exactly the dense
+    ArcMarginHead s·cosθ inference scores for the same embeddings — the
+    eval contract every arcface workload shares (ARCFACE eval semantics),
+    here through the pipelined backbone."""
+    from ddp_classification_pytorch_tpu.models.heads import ArcMarginHead
+    from ddp_classification_pytorch_tpu.models.pipeline_vit import (
+        GPipeArcFaceViT,
+    )
+
+    mesh = meshlib.make_mesh(meshlib.MeshSpec(4, 1, 2), jax.devices()[:8])
+    with mesh:
+        model = GPipeArcFaceViT("vit_t16", 11, mesh, microbatches=2,
+                                dtype=jnp.float32, axis_name="pipe")
+        v = model.init(jax.random.PRNGKey(3), jnp.zeros((1, SIZE, SIZE, 3)))
+        x = jnp.asarray(np.random.default_rng(5).normal(
+            size=(8, SIZE, SIZE, 3)), jnp.float32)
+        scores = np.asarray(model.apply(v, x, None, train=False))
+        emb = np.asarray(model.apply(v, x, train=False, method="features"))
+    head = ArcMarginHead(num_classes=11, in_features=emb.shape[1])
+    ref = head.apply({"params": v["params"]["margin"]}, jnp.asarray(emb), None)
+    np.testing.assert_allclose(scores, np.asarray(ref), rtol=1e-5, atol=1e-5)
+    assert scores.shape == (8, 11)
