@@ -41,7 +41,7 @@ def _build_pair(arch: str, num_classes: int):
 
     import jax.numpy as jnp
 
-    if arch in ("resnet18", "resnet34", "resnet50"):
+    if arch in to._DEPTHS:  # every oracle ResNet depth (single source)
         from ..models import resnet as R
 
         return (to.make_torch_resnet(arch, num_classes),
@@ -68,7 +68,7 @@ def main(argv=None) -> None:
         description="verify a real torch .pth imports exactly")
     ap.add_argument("checkpoint", help="path to the .pth / .pt state_dict")
     ap.add_argument("--arch", default="resnet50",
-                    help="resnet18|resnet34|resnet50|vgg19_bn|tresnet_m")
+                    help="resnet18|34|50|101|152|vgg19_bn|tresnet_m")
     ap.add_argument("--tol", type=float, default=2e-4,
                     help="forward-parity tolerance (f32; the randomized "
                          "oracle suite passes at 2e-4)")

@@ -77,3 +77,15 @@ def test_verify_import_rejects_non_checkpoint(tmp_path):
     junk = tmp_path / "junk.pth"
     junk.write_bytes(b"not a checkpoint")
     assert _run([str(junk), "--arch", "resnet18"]) == 2
+
+
+def test_verify_import_deep_bottleneck_arch(tmp_path, capsys):
+    """resnet101 exercises the deep Bottleneck mapping (layer3 ×23) the
+    randomized parity suite doesn't cover — the reference zoo ships
+    101/152 (SURVEY C11), so the certification command must too."""
+    tmodel = make_torch_resnet("resnet101", 7)
+    randomize_(tmodel, seed=2)
+    path = tmp_path / "r101.pth"
+    torch.save(tmodel.state_dict(), str(path))
+    assert _run([str(path), "--arch", "resnet101"]) == 0
+    assert capsys.readouterr().out.startswith("PASS")
