@@ -219,6 +219,10 @@ def build_parser() -> argparse.ArgumentParser:
     compat.add_argument("--local_rank", type=int, default=None,
                         help="ignored: no per-device processes on TPU; one "
                         "process per host sees all local chips")
+    compat.add_argument("--gpu", default=None,
+                        help="ignored: device selection is the backend's "
+                        "(CDR/main.py:51, NESTED/train.py:473 pass it; "
+                        "scripted reference invocations must not break)")
     return p
 
 
@@ -434,9 +438,10 @@ def main(argv: Optional[Sequence[str]] = None) -> None:
         backend_up = backend_watchdog(600)
     if args.multihost:
         jax.distributed.initialize()
-    if args.world_size is not None or args.local_rank is not None:
-        print("[compat] --world_size/--local_rank are ignored on TPU: one "
-              "process per host, batch shards over the device mesh")
+    if (args.world_size is not None or args.local_rank is not None
+            or args.gpu is not None):
+        print("[compat] --world_size/--local_rank/--gpu are ignored on TPU: "
+              "one process per host, batch shards over the device mesh")
     from ..utils.cache import enable_persistent_cache
 
     enable_persistent_cache()
