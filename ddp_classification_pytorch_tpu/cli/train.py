@@ -460,7 +460,24 @@ def main(argv: Optional[Sequence[str]] = None) -> None:
         # exceed the watchdog on reference-scale data, and the backend is
         # already initialized at this point
     trainer_cls = PLCTrainer if cfg.workload == "plc" else Trainer
-    trainer = trainer_cls(cfg)
+    try:
+        trainer = trainer_cls(cfg)
+    except ValueError as e:
+        import sys
+        import traceback
+
+        # construction-time ValueErrors are config-shaped and deterministic
+        # (MeshSpec.resolve "mesh does not cover N devices" when an axis
+        # doesn't divide the device count, build_model's pipeline arch/head
+        # rejections, make_hybrid_mesh's dcn+pp rejection, a bad dataset or
+        # checkpoint path) — map them to the same rc 2 as config_from_args
+        # so supervise.sh doesn't replay the bug MAX_RESTARTS times with
+        # backoff (ADVICE r4). Keep the traceback: unlike the pre-parse
+        # errors above, construction spans mesh/model/data code and the
+        # message alone may not locate the source.
+        traceback.print_exc(file=sys.stderr)
+        print(f"[trainer] config error: {e}", file=sys.stderr)
+        raise SystemExit(2) from None
     trainer.run()
 
 

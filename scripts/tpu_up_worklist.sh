@@ -55,14 +55,24 @@ echo "   0.2832-MFU chase; verdict goes into docs/performance.md)" >&2
 # (the catcher retries until the VGG record completes) must not burn
 # scarce chip minutes re-measuring identical variants — FORCE_AB=1 to
 # re-run after a code change to the measured paths
-banked_ab=$(ls runs/tpu_window_*/ab_vit_perf.jsonl 2>/dev/null | head -1)
-if [ -n "$banked_ab" ] && [ -s "$banked_ab" ] && [ "${FORCE_AB:-0}" != "1" ]; then
+# find, not a one-level glob: window_catcher.sh banks under
+# runs/tpu_window_auto/window_<stamp>/, two levels deep (ADVICE r4)
+banked_ab=$(find runs -name ab_vit_perf.jsonl -size +0c 2>/dev/null | head -1)
+if [ -n "$banked_ab" ] && [ "${FORCE_AB:-0}" != "1" ]; then
   echo "   already banked: $banked_ab — skipping (FORCE_AB=1 to re-run)" >&2
   abrc=0
 else
-  python scripts/ab_vit_perf.py > "$out/ab_vit_perf.jsonl" 2> "$out/ab_vit_perf.log"
+  # write to a .partial name and rename only on rc=0: a crashed or
+  # window-killed A/B must never leave a file the banked check above would
+  # match in later windows — only a complete run banks
+  python scripts/ab_vit_perf.py > "$out/ab_vit_perf.partial.jsonl" \
+                                2> "$out/ab_vit_perf.log"
   abrc=$?
-  if [ $abrc -ne 0 ]; then
+  if [ $abrc -eq 0 ]; then
+    mv "$out/ab_vit_perf.partial.jsonl" "$out/ab_vit_perf.jsonl"
+    tail -4 "$out/ab_vit_perf.jsonl" >&2
+  else
+    tail -4 "$out/ab_vit_perf.partial.jsonl" >&2
     case $abrc in
       # outage-shaped (docs/operations.md: 3 unreachable, 4 init-watchdog
       # lease churn, 5 mid-run hang deadline, 137/143 killed): stop the
@@ -75,7 +85,6 @@ else
               "VGG record; see $out/ab_vit_perf.log" >&2 ;;
     esac
   fi
-  tail -4 "$out/ab_vit_perf.jsonl" >&2
 fi
 
 echo "== (reference) dense-vs-flash A/B already banked:" >&2

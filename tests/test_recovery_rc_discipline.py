@@ -107,6 +107,22 @@ def test_trainer_config_error_exits_2():
     assert "config error" in p.stderr
 
 
+def test_trainer_construction_config_error_exits_2():
+    """Config-shaped ValueErrors raised during Trainer construction (here:
+    MeshSpec.resolve "mesh does not cover N devices" for a --dp that doesn't
+    divide the device count) must ALSO map to rc 2 — a bare rc 1 would make
+    supervise.sh replay the deterministic bug MAX_RESTARTS times (ADVICE r4)."""
+    p = subprocess.run(
+        [sys.executable, "-m", "ddp_classification_pytorch_tpu.cli.train",
+         "baseline", "--dataset", "synthetic", "--dp", "3", "--epochs", "1"],
+        cwd=REPO, capture_output=True, text=True, timeout=180,
+        env={**os.environ, "JAX_PLATFORMS": "cpu",
+             "XLA_FLAGS": "--xla_force_host_platform_device_count=8"})
+    assert p.returncode == 2, (p.returncode, p.stderr[-500:])
+    assert "config error" in p.stderr
+    assert "does not cover" in p.stderr
+
+
 def test_catcher_stops_loudly_on_broken_probe(tmp_path):
     """rc 127 (missing interpreter) / ImportError is a broken harness, not an
     outage — the catcher must stop with that rc, not poll forever."""
