@@ -1,0 +1,220 @@
+"""Torch ground-truth arm of the digits head-to-head (VERDICT r4 next #3).
+
+Trains a CIFAR-stem ResNet-18 in PLAIN PYTORCH on the exported digits
+imagefolder with the reference BASELINE recipe — CE loss, SGD(momentum 0.9),
+per-iteration linear warmup then MultiStep decay (BASELINE/main.py:153-154,
+:170-197; CIFAR stem per the reference's CIFAR zoo NESTED/model/resnet.py:
+3x3/1 stem, no maxpool, stride-1 conv2_x) — and the SAME hyperparameters,
+split, and transform semantics as the framework's committed
+`runs/digits_rn18` run (docs/convergence.md):
+
+    pad-4 random crop 32 + horizontal flip + ImageNet normalize (train),
+    plain normalize (val); batch 128, lr 0.1, wd 5e-4, warmup 36 iters,
+    milestones (20, 32) gamma 0.1, 40 epochs, seed 999.
+
+The two arms share the dataset and recipe but NOT the rng streams — this is
+the north star's "match top-1 within 0.1%" (BASELINE.json) scaled to the
+one real dataset the sandbox allows: a statistical accuracy comparison, not
+a bitwise one (tests/test_torch_dynamics_parity.py pins the bitwise step
+dynamics separately).
+
+Usage:
+    python scripts/export_digits.py --root /tmp/digits
+    python scripts/torch_digits_baseline.py --folder /tmp/digits \
+        --out runs/digits_rn18_torch_oracle
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import time
+
+import numpy as np
+
+
+def build_cifar_resnet18(num_classes: int):
+    """CIFAR-stem ResNet-18, written for this script (reference semantics:
+    NESTED/model/resnet.py BasicBlock zoo; torchvision naming unnecessary —
+    nothing is converted from this model)."""
+    import torch.nn as nn
+
+    class Block(nn.Module):
+        def __init__(self, c_in, c_out, stride):
+            super().__init__()
+            self.conv1 = nn.Conv2d(c_in, c_out, 3, stride, 1, bias=False)
+            self.bn1 = nn.BatchNorm2d(c_out)
+            self.conv2 = nn.Conv2d(c_out, c_out, 3, 1, 1, bias=False)
+            self.bn2 = nn.BatchNorm2d(c_out)
+            self.relu = nn.ReLU(inplace=True)
+            self.down = None
+            if stride != 1 or c_in != c_out:
+                self.down = nn.Sequential(
+                    nn.Conv2d(c_in, c_out, 1, stride, bias=False),
+                    nn.BatchNorm2d(c_out))
+
+        def forward(self, x):
+            r = x if self.down is None else self.down(x)
+            y = self.relu(self.bn1(self.conv1(x)))
+            y = self.bn2(self.conv2(y))
+            return self.relu(y + r)
+
+    class CifarResNet18(nn.Module):
+        def __init__(self, classes):
+            super().__init__()
+            self.stem = nn.Sequential(
+                nn.Conv2d(3, 64, 3, 1, 1, bias=False),
+                nn.BatchNorm2d(64), nn.ReLU(inplace=True))
+            layers = []
+            c_in = 64
+            for c_out, stride in ((64, 1), (64, 1), (128, 2), (128, 1),
+                                  (256, 2), (256, 1), (512, 2), (512, 1)):
+                layers.append(Block(c_in, c_out, stride))
+                c_in = c_out
+            self.layers = nn.Sequential(*layers)
+            self.fc = nn.Linear(512, classes)
+
+        def forward(self, x):
+            h = self.layers(self.stem(x))
+            return self.fc(h.mean(dim=(2, 3)))
+
+    return CifarResNet18(num_classes)
+
+
+def load_folder(root: str):
+    """Deterministic sorted scan (same contract as data/imagefolder.py) →
+    in-memory uint8 arrays; the whole dataset is 1,797 32x32 images."""
+    from PIL import Image
+
+    out = {}
+    for split in ("train", "val"):
+        xs, ys = [], []
+        classes = sorted(os.listdir(os.path.join(root, split)))
+        for ci, cls in enumerate(classes):
+            d = os.path.join(root, split, cls)
+            for name in sorted(os.listdir(d)):
+                img = Image.open(os.path.join(d, name)).convert("RGB")
+                xs.append(np.asarray(img, np.uint8))
+                ys.append(ci)
+        out[split] = (np.stack(xs), np.array(ys, np.int64))
+    return out
+
+
+MEAN = np.array([0.485, 0.456, 0.406], np.float32)
+STD = np.array([0.229, 0.224, 0.225], np.float32)
+
+
+def normalize(batch_u8: np.ndarray) -> np.ndarray:
+    x = (batch_u8.astype(np.float32) / 255.0 - MEAN) / STD
+    return np.ascontiguousarray(x.transpose(0, 3, 1, 2))
+
+
+def augment(batch_u8: np.ndarray, rng: np.random.Generator) -> np.ndarray:
+    """pad-4 random crop + flip — the 'cifar' train preset
+    (data/transforms.py; NESTED/train.py:40-44 semantics)."""
+    n, h, w, _ = batch_u8.shape
+    padded = np.pad(batch_u8, ((0, 0), (4, 4), (4, 4), (0, 0)))
+    out = np.empty_like(batch_u8)
+    ys = rng.integers(0, 9, n)
+    xs = rng.integers(0, 9, n)
+    flips = rng.uniform(size=n) < 0.5
+    for i in range(n):
+        crop = padded[i, ys[i]:ys[i] + h, xs[i]:xs[i] + w]
+        out[i] = crop[:, ::-1] if flips[i] else crop
+    return out
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--folder", default="/tmp/digits")
+    ap.add_argument("--out", default="runs/digits_rn18_torch_oracle")
+    ap.add_argument("--epochs", type=int, default=40)
+    ap.add_argument("--batchsize", type=int, default=128)
+    ap.add_argument("--lr", type=float, default=0.1)
+    ap.add_argument("--weight_decay", type=float, default=5e-4)
+    ap.add_argument("--warmup_iters", type=int, default=36)
+    ap.add_argument("--milestones", type=int, nargs="+", default=[20, 32])
+    ap.add_argument("--gamma", type=float, default=0.1)
+    ap.add_argument("--seed", type=int, default=999)
+    ap.add_argument("--threads", type=int, default=0,
+                    help=">0: cap torch intra-op threads (leave CPU headroom "
+                         "for the TPU window catcher's probes)")
+    args = ap.parse_args()
+
+    import torch
+
+    if args.threads > 0:
+        torch.set_num_threads(args.threads)
+    torch.manual_seed(args.seed)
+    data = load_folder(args.folder)
+    (xtr, ytr), (xva, yva) = data["train"], data["val"]
+    n_train = len(ytr)
+    steps_per_epoch = (n_train + args.batchsize - 1) // args.batchsize
+
+    model = build_cifar_resnet18(10)
+    opt = torch.optim.SGD(model.parameters(), lr=args.lr, momentum=0.9,
+                          weight_decay=args.weight_decay)
+    lossf = torch.nn.CrossEntropyLoss()
+    rng = np.random.default_rng(args.seed)
+
+    def lr_at(global_it: int, epoch: int) -> float:
+        if global_it < args.warmup_iters:  # BASELINE/main.py:179
+            return 1e-6 + global_it * (args.lr - 1e-6) / args.warmup_iters
+        return args.lr * args.gamma ** sum(epoch >= m for m in args.milestones)
+
+    os.makedirs(args.out, exist_ok=True)
+    rec = open(os.path.join(args.out, "output.txt"), "a", buffering=1)
+    best = {"val_top1": -1.0, "epoch": -1}
+    git = 0
+    for epoch in range(args.epochs):
+        t0 = time.time()
+        model.train()
+        order = rng.permutation(n_train)
+        tloss = tcorr = tn = 0.0
+        for s in range(steps_per_epoch):
+            idx = order[s * args.batchsize:(s + 1) * args.batchsize]
+            xb = normalize(augment(xtr[idx], rng))
+            yb = torch.from_numpy(ytr[idx])
+            opt.param_groups[0]["lr"] = lr_at(git, epoch)
+            git += 1
+            opt.zero_grad()
+            logits = model(torch.from_numpy(xb))
+            loss = lossf(logits, yb)
+            loss.backward()
+            opt.step()
+            tloss += float(loss.detach()) * len(idx)
+            tcorr += float((logits.argmax(1) == yb).sum())
+            tn += len(idx)
+
+        model.eval()
+        vcorr1 = vcorr3 = vloss = 0.0
+        with torch.no_grad():
+            for s in range(0, len(yva), args.batchsize):
+                xb = torch.from_numpy(normalize(xva[s:s + args.batchsize]))
+                yb = torch.from_numpy(yva[s:s + args.batchsize])
+                logits = model(xb)
+                vloss += float(lossf(logits, yb)) * len(yb)
+                top3 = logits.topk(3, dim=1).indices
+                vcorr1 += float((top3[:, 0] == yb).sum())
+                vcorr3 += float((top3 == yb[:, None]).any(1).sum())
+        val_top1 = vcorr1 / len(yva)
+        line = (f"epoch:{epoch}\tloss:{tloss / tn:.6f}\ttop1:{tcorr / tn:.6f}"
+                f"\tval_loss:{vloss / len(yva):.6f}\tval_top1:{val_top1:.6f}"
+                f"\tval_top3:{vcorr3 / len(yva):.6f}"
+                f"\tepoch_time:{time.time() - t0:.2f}")
+        print(line)
+        rec.write(line + "\n")
+        if val_top1 > best["val_top1"]:
+            best = {"val_top1": val_top1, "epoch": epoch}
+    summary = {"arm": "torch_oracle_rn18_cifar_stem", "seed": args.seed,
+               "epochs": args.epochs, "final_val_top1": val_top1,
+               "best_val_top1": best["val_top1"], "best_epoch": best["epoch"],
+               "n_val": int(len(yva))}
+    with open(os.path.join(args.out, "summary.json"), "w") as f:
+        json.dump(summary, f, indent=1)
+    print(json.dumps(summary))
+
+
+if __name__ == "__main__":
+    main()

@@ -46,10 +46,17 @@ def main() -> None:
     mesh = meshlib.make_mesh()
     losses = run_steps(mesh, host_rows=slice(pid * 8, (pid + 1) * 8))
 
+    # composed dp×tp mesh across the REAL process boundary (VERDICT r4 #5):
+    # same shared runner the parent's oracle uses
+    from multihost_common import run_composed_steps
+
+    composed = run_composed_steps(host_rows=slice(pid * 8, (pid + 1) * 8))
+
     ckpt_ok = _checkpoint_tp_sharded_roundtrip(out + ".ckptdir")
     if jax.process_index() == 0:
         with open(out, "w") as f:
-            json.dump({"losses": losses, "ckpt_ok": ckpt_ok}, f)
+            json.dump({"losses": losses, "composed": composed,
+                       "ckpt_ok": ckpt_ok}, f)
 
 
 def _checkpoint_tp_sharded_roundtrip(ckpt_dir: str) -> bool:

@@ -31,7 +31,7 @@ def _free_port() -> int:
 def test_two_process_run_matches_single_process():
     import jax
 
-    from multihost_common import run_steps
+    from multihost_common import run_composed_steps, run_steps
 
     from ddp_classification_pytorch_tpu.parallel import mesh as meshlib
 
@@ -53,12 +53,14 @@ def test_two_process_run_matches_single_process():
         # state with them, and overlapping the two JAX startups roughly
         # halves the test's wall-clock
         oracle = run_steps(meshlib.make_mesh(), host_rows=slice(0, 16))
-        logs = [p.communicate(timeout=280)[0].decode() for p in procs]
+        oracle_composed = run_composed_steps(host_rows=slice(0, 16))
+        logs = [p.communicate(timeout=540)[0].decode() for p in procs]
         for p, log in zip(procs, logs):
             assert p.returncode == 0, f"worker failed:\n{log}"
         with open(out) as f:
             payload = json.load(f)
         losses = payload["losses"]
+        composed = payload["composed"]
         # TP-sharded checkpoint round-trip across the process boundary
         # (shards not addressable from host 0) must preserve the weights
         assert payload["ckpt_ok"] is True
@@ -69,5 +71,8 @@ def test_two_process_run_matches_single_process():
         if os.path.exists(out):
             os.remove(out)
     np.testing.assert_allclose(losses, oracle, atol=1e-5)
+    # composed dp×tp (class-sharded partial-FC CE) across the process
+    # boundary: same math as the single-process 4×2 run
+    np.testing.assert_allclose(composed, oracle_composed, atol=1e-5)
     # the parent's own backend must be unaffected
     assert jax.process_count() == 1
