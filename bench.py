@@ -24,14 +24,22 @@ the driver's whole window): the backend probe budget is capped at ~4.5 min
 (--deadline, default 900 s), extra rows only start while enough budget
 remains, and an unreachable backend exits 3 loudly instead of hanging.
 
+`--e2e` adds an end-to-end row (`<arch>_e2e_images_per_sec_per_chip`):
+the real `ShardedLoader → DevicePrefetcher → train step` pipeline against
+a generated on-disk image folder (synthetic on CPU), so host assembly +
+H2D overlap — the stage the device-only rows exclude by design and
+bench_input.py (host-only) cannot see — is a measured, regression-guarded
+number (docs/performance.md "H2D overlap and the e2e benchmark").
+
 Usage: python bench.py [--batch N] [--steps N] [--arch resnet50]
-                       [--deadline SECONDS] [--rows arcface,vit]
+                       [--deadline SECONDS] [--rows arcface,vit] [--e2e]
 """
 
 from __future__ import annotations
 
 import argparse
 import json
+import os
 import sys
 import time
 
@@ -310,6 +318,103 @@ def _bench_row(cfg, mesh, *, steps: int, warmup: int, metric: str,
     return row
 
 
+def _e2e_metric_name(arch: str, on_accel: bool, platform: str) -> str:
+    """JSON metric name for the end-to-end row — locked by
+    tests/test_bench_meta.py so the schema cannot drift silently."""
+    return (f"{arch}_e2e_images_per_sec_per_chip"
+            + ("" if on_accel else f"_{platform}"))
+
+
+def _bench_e2e_row(cfg, mesh, *, steps: int, warmup: int, metric: str,
+                   n_chips: int, dataset_kind: str, root: str, n_images: int,
+                   src_size: int, device_prefetch: int, num_workers: int):
+    """End-to-end throughput: the real `ShardedLoader → DevicePrefetcher →
+    jitted train step` path against an actual dataset — the one stage
+    neither the device-only rows (input excluded by design) nor
+    bench_input.py (host-only) measures: host batch assembly + H2D staging
+    overlapping device compute. The number is gated by whichever of {host
+    input rate, H2D staging, device step} binds, so read it NEXT TO the
+    device-only row: e2e ≈ device-only means the input path keeps up;
+    e2e well below it localizes the stall to the host/H2D side.
+    """
+    import jax
+    from ddp_classification_pytorch_tpu.data import ShardedLoader
+    from ddp_classification_pytorch_tpu.data.device_prefetch import DevicePrefetcher
+    from ddp_classification_pytorch_tpu.train.loop import make_native_batcher
+    from ddp_classification_pytorch_tpu.train.state import create_train_state
+    from ddp_classification_pytorch_tpu.train.steps import make_train_step
+
+    batcher = None
+    if dataset_kind == "imagefolder":
+        from bench_input import ensure_dataset
+        from ddp_classification_pytorch_tpu.data import (ImageFolderDataset,
+                                                         build_transform)
+
+        ensure_dataset(root, n_images, src_size)
+        tf = build_transform("baseline", train=True,
+                             image_size=cfg.data.image_size)
+        ds = ImageFolderDataset.from_root(root, tf)
+        batcher = make_native_batcher(ds, cfg, train=True)
+        input_path = "native" if batcher is not None else "python"
+    else:
+        from ddp_classification_pytorch_tpu.data import SyntheticDataset
+
+        ds = SyntheticDataset(n_images, cfg.data.image_size,
+                              cfg.data.num_classes)
+        input_path = "synthetic"
+
+    batch = cfg.data.batch_size
+    loader = ShardedLoader(ds, batch, shuffle=True, seed=cfg.run.seed,
+                           num_workers=num_workers,
+                           prefetch=cfg.data.prefetch, batcher=batcher)
+    prefetcher = DevicePrefetcher(loader, mesh, depth=device_prefetch)
+    main_ident = __import__("threading").get_ident()
+
+    def batches():
+        epoch = 0
+        while True:  # as many epochs as warmup+steps need
+            loader.set_epoch(epoch)
+            for b in prefetcher:
+                yield b
+            epoch += 1
+
+    it = None
+    try:
+        with mesh:
+            model, tx, state = create_train_state(
+                cfg, mesh, steps_per_epoch=max(len(loader), 1))
+            step = make_train_step(cfg, model, tx, mesh=mesh)
+            it = batches()
+            metrics = None
+            for _ in range(max(warmup, 1)):  # >=1: compile outside the window
+                state, metrics = step(state, *next(it))
+            float(metrics["loss"])  # hard sync (device-get, see _bench_row)
+            t0 = time.perf_counter()
+            for _ in range(steps):
+                state, metrics = step(state, *next(it))
+            float(metrics["loss"])  # hard sync closes the timing window
+            step_s = (time.perf_counter() - t0) / steps
+    finally:
+        if it is not None:
+            it.close()  # unwinds the prefetcher + its stager thread
+        loader.close()
+
+    return {
+        "metric": metric,
+        "value": round(batch / step_s / n_chips, 2),
+        "unit": "images/sec/chip",
+        "step_ms": round(step_s * 1e3, 2),
+        "device_prefetch": device_prefetch,
+        "input": input_path,
+        "host_workers": num_workers,
+        # evidence the overlap actually ran: how many batches the stager
+        # assembled, and whether assembly happened off the consumer thread
+        "staged_batches": prefetcher.staged,
+        "staged_off_thread": (prefetcher.stager_thread is not None
+                              and prefetcher.stager_thread != main_ident),
+    }
+
+
 DEADLINE_GRACE_S = 120.0  # slack past --deadline before the watchdog fires
 
 
@@ -363,6 +468,25 @@ def main() -> None:
                          "is too thin for another compile.")
     ap.add_argument("--rows", default="arcface,vit",
                     help="comma list of extra rows (arcface, vit); '' = none")
+    ap.add_argument("--e2e", action="store_true",
+                    help="also measure the end-to-end input path: the real "
+                         "ShardedLoader → DevicePrefetcher → train-step "
+                         "pipeline against an on-disk image folder "
+                         "(synthetic data on CPU), emitted as an "
+                         "<arch>_e2e_images_per_sec_per_chip extra row")
+    ap.add_argument("--e2e-dataset", default="",
+                    choices=["", "imagefolder", "synthetic"],
+                    help="'' = imagefolder on accelerators, synthetic on CPU")
+    ap.add_argument("--e2e-root", default="/tmp/bench_imgds",
+                    help="generated image-folder root for --e2e (shared "
+                         "with bench_input.py)")
+    ap.add_argument("--e2e-images", type=int, default=1024)
+    ap.add_argument("--e2e-src-size", type=int, default=320,
+                    help="source JPEG side for the generated folder")
+    ap.add_argument("--e2e-workers", type=int, default=0,
+                    help="host loader threads for --e2e; 0 = cpu count")
+    ap.add_argument("--device-prefetch", type=int, default=2,
+                    help="DevicePrefetcher depth for --e2e (0 = synchronous)")
     args = ap.parse_args()
 
     def remaining() -> float:
@@ -444,7 +568,8 @@ def main() -> None:
     cfg.model.arch = args.arch
     cfg.model.dtype = "bfloat16" if on_accel else "float32"
     cfg.data.num_classes = 1000
-    cfg.data.image_size = args.image_size if on_accel else 64
+    # CPU caps (not pins) the image size so smoke runs can shrink further
+    cfg.data.image_size = args.image_size if on_accel else min(args.image_size, 64)
     # 128/chip is the measured v5e sweet spot for RN50/224 (probe sweep:
     # 2676 img/s at 128 vs 2523 at 256 vs 2428 at 512 — docs/performance.md)
     cfg.data.batch_size = args.batch or (128 * n_chips if on_accel else 8 * n_chips)
@@ -538,6 +663,34 @@ def main() -> None:
         except Exception as e:  # a broken extra row must not cost the flagship line
             print(f"# extra row {name!r} failed: {type(e).__name__}: {e}",
                   file=sys.stderr)
+
+    if args.e2e:
+        e2e_budget = 180.0  # one jit compile + a dataset pass
+        if remaining() < e2e_budget:
+            print(f"# skipping e2e row: {remaining():.0f}s left "
+                  f"< {e2e_budget:.0f}s budget", file=sys.stderr)
+        else:
+            try:
+                kind = args.e2e_dataset or (
+                    "imagefolder" if on_accel else "synthetic")
+                row = _bench_e2e_row(
+                    cfg, mesh, steps=steps, warmup=max(warmup // 2, 1),
+                    metric=_e2e_metric_name(args.arch, on_accel, platform),
+                    n_chips=n_chips, dataset_kind=kind, root=args.e2e_root,
+                    n_images=args.e2e_images, src_size=args.e2e_src_size,
+                    device_prefetch=args.device_prefetch,
+                    num_workers=args.e2e_workers or (os.cpu_count() or 4),
+                )
+                extra.append(row)
+                partial_box["row"] = dict(partial_box["row"], extra=list(extra))
+                print(f"# e2e row ({row['input']}, prefetch "
+                      f"{row['device_prefetch']}): {row['value']} img/s/chip, "
+                      f"step {row['step_ms']}ms, staged "
+                      f"{row['staged_batches']} off-thread="
+                      f"{row['staged_off_thread']}", file=sys.stderr)
+            except Exception as e:  # e2e must not cost the flagship line either
+                print(f"# e2e row failed: {type(e).__name__}: {e}",
+                      file=sys.stderr)
 
     if probe:
         main_row["probe"] = probe

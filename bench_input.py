@@ -16,7 +16,9 @@ flagship ResNet-50 rate), e.g.:
 
 Reference counterpart: `DataLoader(num_workers=4, pin_memory=True)`
 (BASELINE/main.py:130-131) — the reference never measured it either;
-SURVEY §7.3 ranks input throughput the #1 hard part.
+SURVEY §7.3 ranks input throughput the #1 hard part. The remaining stage —
+batch assembly + H2D overlapping device compute — is `bench.py --e2e`
+(docs/performance.md "H2D overlap and the e2e benchmark").
 
 Usage: python bench_input.py [--root DIR] [--images N] [--batch N]
                              [--workers N] [--chip-rate R]

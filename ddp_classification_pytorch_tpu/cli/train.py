@@ -50,6 +50,11 @@ def build_parser() -> argparse.ArgumentParser:
     d.add_argument("--imgs_per_class", type=int, default=0,
                    help="per-class cap (500 baseline / 400 arcface)")
     d.add_argument("--num_workers", type=int, default=0, help="host loader threads")
+    d.add_argument("--device_prefetch", type=int, default=-1,
+                   help="device batches staged ahead of the step loop by a "
+                        "background H2D stager thread (default 2; each "
+                        "staged batch holds device memory; 0 = synchronous "
+                        "assembly inside the step loop)")
     d.add_argument("--image_size", type=int, default=0)
     d.add_argument("--crop_size", type=int, default=0,
                    help="train-crop / resize-short side (default 256, the "
@@ -255,6 +260,8 @@ def config_from_args(args: argparse.Namespace) -> Config:
         cfg.data.imgs_per_class = args.imgs_per_class
     if args.num_workers:
         cfg.data.num_workers = args.num_workers
+    if args.device_prefetch >= 0:
+        cfg.data.device_prefetch = args.device_prefetch
     if args.image_size:
         cfg.data.image_size = args.image_size
     if args.crop_size:

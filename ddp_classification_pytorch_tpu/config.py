@@ -36,6 +36,13 @@ class DataConfig:
     batch_size: int = 16  # per-process global batch is batch_size * num_hosts
     num_workers: int = 4  # BASELINE/main.py:130-131
     prefetch: int = 2
+    # device-side prefetch depth (data/device_prefetch.py): a background
+    # stager thread keeps this many fully-formed, globally-sharded device
+    # batches staged ahead of the step loop, so batch assembly + H2D
+    # transfer overlap device compute instead of serializing with it. Each
+    # staged batch holds device memory (~depth extra batches of HBM).
+    # 0 = synchronous assembly inside the step loop (the pre-prefetch path).
+    device_prefetch: int = 2
     synthetic_size: int = 0  # for dataset == "synthetic"
     # transform preset: baseline | cdr | cifar | clothing1m (SURVEY C15)
     transform: str = "baseline"

@@ -3,12 +3,13 @@ from .synthetic import SyntheticDataset
 from .cifar import CIFARDataset
 from .transforms import TRANSFORM_PRESETS, build_transform
 from .loader import ShardedLoader, shard_indices_for_host
+from .device_prefetch import DevicePrefetcher
 from .native import NativeBatcher, native_load_batch
 from .plc import PLCDataset
 
 __all__ = [
     "ImageFolderDataset", "scan_image_folder", "SyntheticDataset",
     "CIFARDataset", "TRANSFORM_PRESETS", "build_transform", "ShardedLoader",
-    "shard_indices_for_host", "NativeBatcher", "native_load_batch",
-    "PLCDataset",
+    "shard_indices_for_host", "DevicePrefetcher", "NativeBatcher",
+    "native_load_batch", "PLCDataset",
 ]

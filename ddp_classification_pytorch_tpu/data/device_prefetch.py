@@ -1,0 +1,150 @@
+"""Device-side prefetch: overlap batch assembly + H2D transfer with compute.
+
+`ShardedLoader` overlaps JPEG decode/augment with the step loop, but the
+*last* stage of the input path — host batch assembly plus the H2D staging
+inside `parallel.mesh.make_global_array` (`jax.make_array_from_process_local_
+data`) — used to run synchronously inside the Python step loop: every step
+paid it before the next device step could dispatch. jax's async dispatch
+hides device latency behind host code, not host latency behind device code,
+so that per-step host time was pure pipeline stall (SURVEY §7.3 ranks input
+throughput the #1 hard part; neither bench.py — device-only by design — nor
+bench_input.py — host-only — could see this stage).
+
+`DevicePrefetcher` moves that stage onto a background *stager* thread that
+keeps up to `depth` fully-formed, globally-sharded device batches staged
+ahead of the consumer in a bounded buffer. The step loop's per-step host
+work shrinks to a queue get + dispatch. Teardown/error discipline mirrors
+`ShardedLoader.__iter__` (data/loader.py): bounded queue, stop-event
+protocol that cannot deadlock a producer on a full queue, worker exceptions
+re-raised at the iteration site, `None` sentinel for end-of-iteration.
+
+Memory cost: each staged batch holds device memory, so depth N keeps up to
+N extra batches (plus one in the stager's hand) resident in HBM. Depth 0
+degrades to the exact synchronous path — same calls, same order, inline.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Any, Callable, Iterable, Iterator, Optional
+
+
+class DevicePrefetcher:
+    """Iterates device-staged batches from a host-batch iterable.
+
+    host_batches: any (re-)iterable yielding host batches — typically a
+        `ShardedLoader`. Each `__iter__` call starts a fresh pass (and a
+        fresh stager thread), so one prefetcher can serve many epochs;
+        single consumer at a time.
+    mesh: target mesh for the default assemble (`make_global_array`).
+    depth: staged batches kept ahead of the consumer. 0 = synchronous
+        fallback (bit-for-bit the pre-prefetch path).
+    assemble: optional `(batch_idx, host_batch) -> device_batch` override.
+        Runs ON THE STAGER THREAD, so per-batch host work placed here (e.g.
+        the eval path's `valid_mask`) also leaves the critical path. Must
+        be thread-safe with respect to the consumer.
+    """
+
+    def __init__(
+        self,
+        host_batches: Iterable[Any],
+        mesh: Optional[Any] = None,
+        *,
+        depth: int = 2,
+        assemble: Optional[Callable[[int, Any], Any]] = None,
+    ):
+        if assemble is None:
+            if mesh is None:
+                raise ValueError(
+                    "DevicePrefetcher needs a mesh (for the default "
+                    "make_global_array assemble) or an explicit assemble fn")
+            assemble = self._default_assemble(mesh)
+        self.host = host_batches
+        self.depth = max(int(depth), 0)
+        self._assemble = assemble
+        # introspection for tests/benchmarks: total batches staged across
+        # all passes, and the ident of the active stager thread (None while
+        # synchronous) — cheap evidence of WHERE staging ran
+        self.staged = 0
+        self.stager_thread: Optional[int] = None
+
+    @staticmethod
+    def _default_assemble(mesh) -> Callable[[int, Any], Any]:
+        # late imports keep `data` importable without initializing jax
+        from ..parallel import mesh as meshlib
+
+        sharding = meshlib.batch_sharding(mesh)
+
+        def assemble(batch_idx: int, host_batch: Any) -> Any:
+            return meshlib.make_global_array(host_batch, mesh, sharding=sharding)
+
+        return assemble
+
+    def __iter__(self) -> Iterator[Any]:
+        if self.depth == 0:
+            # synchronous fallback: identical assembly calls in identical
+            # order, inline on the consumer thread
+            self.stager_thread = None
+            for i, hb in enumerate(self.host):
+                out = self._assemble(i, hb)
+                self.staged += 1
+                yield out
+            return
+
+        q: "queue.Queue" = queue.Queue(maxsize=self.depth)
+        stop = threading.Event()
+        error: list = []
+
+        def put_or_stop(item) -> bool:
+            """Bounded put that gives up when the consumer abandoned us —
+            never deadlocks the stager on a full queue at teardown."""
+            while not stop.is_set():
+                try:
+                    q.put(item, timeout=0.1)
+                    return True
+                except queue.Full:
+                    continue
+            return False
+
+        def stager():
+            it = iter(self.host)
+            try:
+                for i, hb in enumerate(it):
+                    if stop.is_set():
+                        return
+                    staged = self._assemble(i, hb)
+                    self.staged += 1
+                    if not put_or_stop(staged):
+                        return
+            except BaseException as e:  # re-raised at the iteration site
+                error.append(e)
+            finally:
+                # unwind the host iterator NOW (a ShardedLoader pass has its
+                # own producer thread + queue) rather than at GC time
+                close = getattr(it, "close", None)
+                if close is not None:
+                    close()
+                put_or_stop(None)
+
+        t = threading.Thread(target=stager, daemon=True, name="device-stager")
+        t.start()
+        self.stager_thread = t.ident
+        try:
+            while True:
+                item = q.get()
+                if item is None:
+                    break
+                yield item
+            if error:
+                # a silently truncated epoch would corrupt training
+                # invisibly — surface the stager failure where it's consumed
+                raise error[0]
+        finally:
+            stop.set()
+            # drain so a stager blocked on a full queue can exit
+            while t.is_alive():
+                try:
+                    q.get_nowait()
+                except queue.Empty:
+                    break

@@ -16,8 +16,10 @@ This is the corrected, TPU-native replacement for the reference's
   `num_workers` + `pin_memory`.
 
 The loader yields host-local numpy batches; `parallel/mesh.py:make_global_array`
-assembles them into a globally-sharded `jax.Array` over the `data` axis (the
-device side of the old H2D `pin_memory` overlap).
+assembles them into a globally-sharded `jax.Array` over the `data` axis, and
+`data/device_prefetch.py:DevicePrefetcher` runs that assembly on a stager
+thread so the H2D stage overlaps device compute (the full `pin_memory` +
+`non_blocking` analogue).
 """
 
 from __future__ import annotations
@@ -101,6 +103,11 @@ class ShardedLoader:
         self.host_id = host_id
         self.num_hosts = num_hosts
         self.epoch = 0
+        # one O(n) permutation per (epoch, dataset length) — __len__ and
+        # __iter__ used to recompute it on every call (review finding); the
+        # key self-invalidates on set_epoch and on dataset growth/shrink
+        self._cached_indices: Optional[np.ndarray] = None
+        self._cache_key: Optional[Tuple[int, int]] = None
         # one pool for the loader's lifetime — a per-batch pool would pay
         # thread spawn/teardown on every batch of every epoch
         self._pool = (
@@ -124,23 +131,47 @@ class ShardedLoader:
         self.epoch = epoch
 
     def _epoch_indices(self) -> np.ndarray:
-        return shard_indices_for_host(
-            len(self.dataset), self.epoch, self.seed, self.batch_size,
-            self.shuffle, self.host_id, self.num_hosts, self.drop_last,
-        )
+        key = (self.epoch, len(self.dataset))
+        if self._cached_indices is None or self._cache_key != key:
+            self._cached_indices = shard_indices_for_host(
+                len(self.dataset), self.epoch, self.seed, self.batch_size,
+                self.shuffle, self.host_id, self.num_hosts, self.drop_last,
+            )
+            self._cache_key = key
+        return self._cached_indices
+
+    def _per_host_len(self) -> int:
+        """This host's padded epoch length, derived arithmetically —
+        `shard_indices_for_host` pads the permutation to a multiple of
+        num_hosts·batch_size and slices it evenly, so the length never
+        needs the O(n) permutation itself."""
+        import jax
+
+        num_hosts = jax.process_count() if self.num_hosts is None else self.num_hosts
+        n = len(self.dataset)
+        chunk = num_hosts * self.batch_size
+        if self.drop_last:
+            total = (n // chunk) * chunk
+        elif n % chunk:
+            total = ((n // chunk) + 1) * chunk
+        else:
+            total = n
+        return total // num_hosts
 
     def __len__(self) -> int:
-        return len(self._epoch_indices()) // self.batch_size
+        return self._per_host_len() // self.batch_size
 
     def valid_mask(self, batch_idx: int) -> np.ndarray:
         """(batch_size,) 1.0 where the row is a real sample, 0.0 where it is
         wrap-padding — exact-eval support (only meaningful for ordered,
-        shuffle=False loaders, where the padded tail duplicates the head)."""
+        shuffle=False loaders, where the padded tail duplicates the head).
+        Pure arithmetic (no permutation), so it is cheap and thread-safe to
+        call from a `DevicePrefetcher` stager."""
         assert not self.shuffle, "valid_mask is defined for ordered loaders"
         import jax
 
         host = jax.process_index() if self.host_id is None else self.host_id
-        per_host = len(self._epoch_indices())
+        per_host = self._per_host_len()
         start = host * per_host + batch_idx * self.batch_size
         pos = start + np.arange(self.batch_size)
         return (pos < len(self.dataset)).astype(np.float32)

@@ -147,11 +147,18 @@ def replicated(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, P())
 
 
-def make_global_array(host_batch: Any, mesh: Mesh) -> Any:
+def make_global_array(host_batch: Any, mesh: Mesh,
+                      sharding: Optional[NamedSharding] = None) -> Any:
     """Assemble per-host numpy batches into a globally batch-sharded
     jax.Array (the H2D step; replaces `.cuda(non_blocking=True)` +
-    DistributedSampler semantics, BASELINE/main.py:273-274)."""
-    sharding = batch_sharding(mesh)
+    DistributedSampler semantics, BASELINE/main.py:273-274).
+
+    Safe to call from a background stager thread (data/device_prefetch.py
+    overlaps this stage with device compute): it only constructs arrays,
+    touching no global backend state. `sharding` lets per-batch hot loops
+    reuse a prebuilt `batch_sharding(mesh)` instead of reconstructing it."""
+    if sharding is None:
+        sharding = batch_sharding(mesh)
 
     def put(x):
         x = np.asarray(x)

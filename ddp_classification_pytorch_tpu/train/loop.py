@@ -14,8 +14,12 @@ reference's observable behavior:
 
 TPU-first details the reference has no analogue for:
 - batches go host→device through `make_global_array` (per-host shard of a
-  global batch-sharded jax.Array) while the device runs the previous step —
-  jax's async dispatch gives the pin_memory/non_blocking overlap for free;
+  global batch-sharded jax.Array) on a background stager thread
+  (`data/device_prefetch.py`) that keeps `data.device_prefetch` device
+  batches staged ahead of the step loop — async dispatch hides device
+  latency, the stager hides the HOST assembly+H2D latency (the full
+  pin_memory/non_blocking overlap; `--device_prefetch 0` restores
+  synchronous in-loop assembly);
 - metrics come back as device scalars only when a log line is actually
   printed (the reference syncs `.item()` every logged step);
 - LR schedule/warmup live inside the optimizer (schedule.py), so there is no
@@ -33,6 +37,7 @@ import jax
 import numpy as np
 
 from ..config import Config
+from ..data.device_prefetch import DevicePrefetcher
 from ..data.loader import ShardedLoader
 from ..data.imagefolder import ImageFolderDataset
 from ..data.native import NativeBatcher
@@ -270,13 +275,19 @@ class Trainer:
             host0_print(f"[trainer] profiler trace captured → {self._prof_dir}")
 
     # ---------------------------------------------------------------- train --
+    def _device_prefetcher(self, loader, assemble=None) -> DevicePrefetcher:
+        """Staged-batch view of `loader` at the configured depth: batch
+        assembly + H2D run on a stager thread (depth 0 = inline)."""
+        return DevicePrefetcher(loader, self.mesh,
+                                depth=self.cfg.data.device_prefetch,
+                                assemble=assemble)
+
     def train_epoch(self, epoch: int, eta: Optional[EtaLogger] = None) -> Dict[str, float]:
         self.train_loader.set_epoch(epoch)
         sums = None  # device-side accumulation: no per-step host sync, so the
         n_batches = 0  # host keeps dispatching ahead of the device
-        for step, (images, labels) in enumerate(self.train_loader):
+        for step, batch in enumerate(self._device_prefetcher(self.train_loader)):
             self._maybe_profile_start(epoch, step)
-            batch = meshlib.make_global_array((images, labels), self.mesh)
             self.state, metrics = self.train_step(self.state, *batch)
             self._maybe_profile_stop(epoch, step, metrics)
             n_batches += 1
@@ -296,14 +307,21 @@ class Trainer:
         return out
 
     # ----------------------------------------------------------------- eval --
+    def _stage_eval_batch(self, b_idx: int, host_batch) -> Any:
+        """Eval assemble hook, run on the stager thread: the per-batch
+        `valid_mask` (wrap-padding mask, pure index arithmetic) is computed
+        here so it also leaves the step loop's critical path."""
+        images, labels = host_batch
+        valid = self.val_loader.valid_mask(b_idx)
+        return meshlib.make_global_array((images, labels, valid), self.mesh)
+
     def evaluate(self) -> Dict[str, float]:
         if self.nested_eval_step is not None:
             return self._evaluate_nested()
         totals = None  # device-side accumulation: a float() per batch would
         # serialize eval dispatch (4 device-gets/batch); sync once at the end
-        for b_idx, (images, labels) in enumerate(self.val_loader):
-            valid = self.val_loader.valid_mask(b_idx)
-            batch = meshlib.make_global_array((images, labels, valid), self.mesh)
+        for batch in self._device_prefetcher(self.val_loader,
+                                             assemble=self._stage_eval_batch):
             out = self.eval_step(self.state, *batch)
             totals = out if totals is None else jax.tree_util.tree_map(
                 jax.numpy.add, totals, out)
@@ -320,9 +338,8 @@ class Trainer:
 
     def _evaluate_nested(self) -> Dict[str, float]:
         t1 = t3 = n_dev = None  # accumulate on device; one sync at the end
-        for b_idx, (images, labels) in enumerate(self.val_loader):
-            valid = self.val_loader.valid_mask(b_idx)
-            batch = meshlib.make_global_array((images, labels, valid), self.mesh)
+        for batch in self._device_prefetcher(self.val_loader,
+                                             assemble=self._stage_eval_batch):
             out = self.nested_eval_step(self.state, *batch)
             t1 = out["top1_k"] if t1 is None else t1 + out["top1_k"]
             t3 = out["top3_k"] if t3 is None else t3 + out["top3_k"]

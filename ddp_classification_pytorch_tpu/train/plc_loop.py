@@ -152,11 +152,18 @@ class PLCTrainer(Trainer):
             prefetch=self.cfg.data.prefetch,
             batcher=predict_batcher,
         )
+        # stage ONLY the image array — labels are discarded here, and None
+        # placeholders have no business going through make_global_array's
+        # tree_map (they only "worked" because tree_map treats None as an
+        # empty subtree). The stager thread overlaps this pass's H2D with
+        # the predict-step dispatches, same as the train/eval loops.
+        prefetcher = self._device_prefetcher(
+            loader,
+            assemble=lambda i, hb: meshlib.make_global_array(hb[0], self.mesh))
         local_chunks = []  # this host's rows of each global batch
         try:
-            for images, _ in loader:
-                batch = meshlib.make_global_array((images, None), self.mesh)
-                logits = self.predict_step(self.state, batch[0])
+            for global_images in prefetcher:
+                logits = self.predict_step(self.state, global_images)
                 # gather ONLY the addressable (this-host) shard rows — exact on
                 # any pod topology, no cross-host transfer. Dedup by row range:
                 # with a >1 'model' axis the row shards are replicated across it.

@@ -76,6 +76,68 @@ def test_contention_annotation_thresholds():
     assert "contended" in ann["note"] or "loaded" in ann["note"]
 
 
+def test_e2e_metric_name_schema():
+    """Lock the e2e row's metric naming: the TPU capture must emit exactly
+    `resnet50_e2e_images_per_sec_per_chip` (regression-guarded next to the
+    device-only flagship row), with the standard platform suffix off-accel."""
+    import bench
+
+    assert (bench._e2e_metric_name("resnet50", True, "tpu")
+            == "resnet50_e2e_images_per_sec_per_chip")
+    assert (bench._e2e_metric_name("resnet18", False, "cpu")
+            == "resnet18_e2e_images_per_sec_per_chip_cpu")
+
+
+def test_bench_cli_has_e2e_flags():
+    """The --e2e surface must keep parsing (the smoke below drives the row
+    builder directly, so argparse drift would otherwise go unseen)."""
+    p = subprocess.run([sys.executable, "bench.py", "--help"], cwd=REPO,
+                       capture_output=True, timeout=60)
+    assert p.returncode == 0, p.stderr[-300:]
+    helptext = p.stdout.decode()
+    for flag in ("--e2e", "--e2e-dataset", "--e2e-images", "--e2e-root",
+                 "--device-prefetch", "--e2e-workers"):
+        assert flag in helptext, flag
+
+
+def test_bench_e2e_row_smoke_cpu():
+    """Run the e2e bench path (the exact `_bench_e2e_row` that `bench.py
+    --e2e` calls) for a handful of steps on the CPU backend with a tiny
+    synthetic dataset, and lock the emitted row's schema: the driver's
+    regression guard keys on these fields."""
+    import jax
+
+    import bench
+    from ddp_classification_pytorch_tpu.config import get_preset
+    from ddp_classification_pytorch_tpu.parallel import mesh as meshlib
+
+    cfg = get_preset("baseline")
+    cfg.model.arch = "resnet18"
+    cfg.model.variant = "cifar"
+    cfg.model.dtype = "float32"
+    cfg.data.num_classes = 8
+    cfg.data.image_size = 32
+    cfg.data.batch_size = 16
+    mesh = meshlib.make_mesh()
+    n_chips = len(jax.devices())
+    metric = bench._e2e_metric_name("resnet18", False, "cpu")
+    row = bench._bench_e2e_row(
+        cfg, mesh, steps=2, warmup=1, metric=metric, n_chips=n_chips,
+        dataset_kind="synthetic", root="", n_images=64, src_size=0,
+        device_prefetch=2, num_workers=2)
+
+    assert row["metric"] == "resnet18_e2e_images_per_sec_per_chip_cpu"
+    assert row["unit"] == "images/sec/chip"
+    assert row["value"] > 0
+    assert row["step_ms"] > 0
+    assert row["device_prefetch"] == 2
+    assert row["input"] == "synthetic"
+    # the acceptance evidence: the stager thread, not the timing loop's
+    # thread, produced the staged batches
+    assert row["staged_batches"] >= 3
+    assert row["staged_off_thread"] is True
+
+
 def test_watchdog_disarm_prevents_exit():
     src = (
         "import time, bench\n"

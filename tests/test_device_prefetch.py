@@ -1,0 +1,222 @@
+"""DevicePrefetcher tests on the virtual 8-device CPU mesh.
+
+The prefetch pipeline moves batch assembly + H2D staging
+(`make_global_array`) onto a background stager thread. These tests pin the
+contract: staged batches are bit-identical to the synchronous path and in
+order; worker exceptions surface at the iteration site; teardown on early
+exit cannot deadlock; the buffer is depth-bounded; and the Trainer's hot
+loop really does stage off the consumer thread (depth 0 really doesn't).
+"""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import jax
+
+from ddp_classification_pytorch_tpu.config import get_preset
+from ddp_classification_pytorch_tpu.data.device_prefetch import DevicePrefetcher
+from ddp_classification_pytorch_tpu.data.loader import ShardedLoader
+from ddp_classification_pytorch_tpu.data.synthetic import SyntheticDataset
+from ddp_classification_pytorch_tpu.parallel import mesh as meshlib
+from ddp_classification_pytorch_tpu.train.loop import Trainer
+
+
+def _loader(n=64, batch=8, image=4, **kw):
+    ds = SyntheticDataset(n, image, 4, seed=7)
+    kw.setdefault("shuffle", False)
+    return ShardedLoader(ds, batch, seed=7, num_workers=1,
+                         host_id=0, num_hosts=1, **kw)
+
+
+def _get(batch):
+    return jax.tree_util.tree_map(np.asarray, jax.device_get(batch))
+
+
+def test_batches_match_undecorated_loader_in_order():
+    loader = _loader()
+    mesh = meshlib.make_mesh()
+    sync = [_get(b) for b in DevicePrefetcher(loader, mesh, depth=0)]
+    staged = [_get(b) for b in DevicePrefetcher(loader, mesh, depth=2)]
+    assert len(sync) == len(staged) == len(loader)
+    for (si, sl), (pi, pl) in zip(sync, staged):
+        np.testing.assert_array_equal(si, pi)
+        np.testing.assert_array_equal(sl, pl)
+
+
+def test_reiterable_across_epochs():
+    loader = _loader(n=32, batch=8, shuffle=True)
+    mesh = meshlib.make_mesh()
+    pre = DevicePrefetcher(loader, mesh, depth=1)
+    loader.set_epoch(0)
+    e0 = [_get(b)[1] for b in pre]
+    loader.set_epoch(1)
+    e1 = [_get(b)[1] for b in pre]
+    assert len(e0) == len(e1) == 4
+    # different epoch → different permutation of the same label multiset
+    assert not all(np.array_equal(a, b) for a, b in zip(e0, e1))
+    np.testing.assert_array_equal(np.sort(np.concatenate(e0)),
+                                  np.sort(np.concatenate(e1)))
+
+
+class _Poisoned:
+    def __len__(self):
+        return 64
+
+    def __getitem__(self, i, rng=None):
+        if i == 40:
+            raise RuntimeError("corrupt sample")
+        return np.zeros((4, 4, 3), np.float32), 0
+
+
+def test_dataset_exception_propagates_through_both_threads():
+    loader = ShardedLoader(_Poisoned(), 8, shuffle=False, num_workers=2,
+                           host_id=0, num_hosts=1)
+    pre = DevicePrefetcher(loader, meshlib.make_mesh(), depth=2)
+    with pytest.raises(RuntimeError, match="corrupt sample"):
+        list(pre)
+
+
+def test_assemble_exception_propagates():
+    def explode(i, hb):
+        if i == 2:
+            raise ValueError("bad stage")
+        return hb
+
+    pre = DevicePrefetcher(_loader(), depth=2, assemble=explode)
+    with pytest.raises(ValueError, match="bad stage"):
+        list(pre)
+
+
+def test_early_break_tears_down_and_reiterates():
+    loader = _loader(n=128, batch=8)
+    mesh = meshlib.make_mesh()
+    pre = DevicePrefetcher(loader, mesh, depth=1)
+    for i, _ in enumerate(pre):
+        if i == 1:
+            break  # abandon mid-epoch: stager + loader producer must exit
+    deadline = time.time() + 5.0
+    while time.time() < deadline:
+        if not any(t.name == "device-stager" and t.is_alive()
+                   for t in threading.enumerate()):
+            break
+        time.sleep(0.05)
+    else:
+        pytest.fail("stager thread still alive after abandoned iteration")
+    # a fresh full pass must work — would hang if teardown deadlocked
+    assert len(list(pre)) == 16
+
+
+def test_buffer_is_depth_bounded():
+    depth = 2
+    staged = []
+    consumed = []
+    overshoot = []
+
+    def assemble(i, hb):
+        staged.append(i)
+        overshoot.append(len(staged) - len(consumed))
+        return hb
+
+    pre = DevicePrefetcher(_loader(n=96, batch=8), depth=depth,
+                           assemble=assemble)
+    for b in pre:
+        consumed.append(b)
+        time.sleep(0.02)  # slow consumer: the stager runs far ahead if unbounded
+    assert len(staged) == 12
+    # stager may be ahead by: `depth` queued + 1 in its own hand + 1 popped
+    # but not yet recorded by the consumer — never more (an unbounded
+    # buffer would reach 11 here with this consumer pacing)
+    assert max(overshoot) <= depth + 2, max(overshoot)
+
+
+def test_staging_runs_on_stager_thread():
+    idents = []
+
+    def assemble(i, hb):
+        idents.append(threading.get_ident())
+        return hb
+
+    pre = DevicePrefetcher(_loader(n=32, batch=8), depth=2, assemble=assemble)
+    list(pre)
+    assert pre.staged == 4
+    assert pre.stager_thread is not None
+    assert set(idents) == {pre.stager_thread}
+    assert threading.get_ident() not in idents
+
+    # depth 0: inline on the consumer thread, stager_thread stays None
+    idents.clear()
+    sync = DevicePrefetcher(_loader(n=32, batch=8), depth=0, assemble=assemble)
+    list(sync)
+    assert sync.stager_thread is None
+    assert set(idents) == {threading.get_ident()}
+
+
+def test_requires_mesh_or_assemble():
+    with pytest.raises(ValueError, match="mesh"):
+        DevicePrefetcher(_loader())
+
+
+# ---------------------------------------------------------------- trainer --
+
+def _tiny_cfg(prefetch_depth):
+    cfg = get_preset("baseline")
+    cfg.data.dataset = "synthetic"
+    cfg.data.image_size = 32
+    cfg.data.num_classes = 4
+    cfg.data.synthetic_size = 128
+    cfg.data.batch_size = 32
+    cfg.data.num_workers = 2
+    cfg.data.device_prefetch = prefetch_depth
+    cfg.model.arch = "resnet18"
+    cfg.model.variant = "cifar"
+    cfg.model.dtype = "float32"
+    cfg.run.epochs = 1
+    cfg.run.write_records = False
+    cfg.run.save_every_epoch = False
+    return cfg
+
+
+def test_trainer_prefetch_stages_off_thread_and_matches_sync_bitwise(monkeypatch):
+    """Two acceptance criteria through ONE Trainer (the compile is the cost
+    here; `device_prefetch` is read per epoch, so the same trainer replays
+    the same epoch from a state snapshot under both depths):
+
+    - with device_prefetch >= 1, the per-step host time between dispatches
+      no longer includes batch assembly/H2D — every make_global_array call
+      in train AND eval lands on a stager thread (and with depth 0, every
+      call is back inline on the consumer thread);
+    - depth 0 falls back to the synchronous path bit-for-bit: identical
+      epoch metrics on the synthetic dataset (the prefetcher changes WHERE
+      assembly runs, never WHAT is computed)."""
+    main_ident = threading.get_ident()
+    idents = []
+    real = meshlib.make_global_array
+
+    def spy(batch, mesh, sharding=None):
+        idents.append(threading.get_ident())
+        return real(batch, mesh, sharding=sharding)
+
+    monkeypatch.setattr(meshlib, "make_global_array", spy)
+
+    tr = Trainer(_tiny_cfg(2))
+    # deep copy: the train step DONATES the state buffers (steps.py), so an
+    # alias would be invalidated by the first epoch
+    state0 = jax.tree_util.tree_map(jax.numpy.copy, tr.state)
+    train_pre = tr.train_epoch(0)
+    eval_pre = tr.evaluate()
+    assert idents, "make_global_array never called"
+    assert main_ident not in idents
+
+    # same trainer, same starting state, synchronous depth-0 replay
+    idents.clear()
+    tr.state = state0
+    tr.cfg.data.device_prefetch = 0
+    train_sync = tr.train_epoch(0)
+    eval_sync = tr.evaluate()
+    assert idents and set(idents) == {main_ident}
+
+    assert train_sync == train_pre, (train_sync, train_pre)
+    assert eval_sync == eval_pre, (eval_sync, eval_pre)

@@ -102,6 +102,41 @@ def test_tiny_dataset_pads_to_full_batch():
     assert loader.valid_mask(0)[5:].sum() == 0
 
 
+def test_len_and_valid_mask_skip_the_permutation_and_cache_indices(monkeypatch):
+    """__len__/valid_mask used to recompute the full O(n) epoch permutation
+    on EVERY call (review finding): derive lengths arithmetically, compute
+    the permutation once per epoch, and invalidate on set_epoch."""
+    import ddp_classification_pytorch_tpu.data.loader as loader_mod
+
+    class Tiny:
+        def __len__(self):
+            return 10
+
+        def __getitem__(self, i, rng=None):
+            return np.zeros((2, 2, 3), np.float32), 0
+
+    calls = []
+    real = loader_mod.shard_indices_for_host
+    monkeypatch.setattr(loader_mod, "shard_indices_for_host",
+                        lambda *a, **kw: (calls.append(1), real(*a, **kw))[1])
+
+    loader = ShardedLoader(Tiny(), batch_size=4, shuffle=False,
+                           host_id=0, num_hosts=1)
+    assert len(loader) == 3 and len(loader) == 3
+    loader.valid_mask(0)
+    loader.valid_mask(2)
+    assert calls == []  # pure arithmetic — no permutation materialized
+
+    idx0 = loader._epoch_indices()
+    assert loader._epoch_indices() is idx0  # cached within the epoch
+    assert calls == [1]
+    loader.set_epoch(1)
+    idx1 = loader._epoch_indices()
+    assert calls == [1, 1]  # set_epoch invalidated the cache
+    assert loader._epoch_indices() is idx1
+    np.testing.assert_array_equal(idx0, idx1)  # shuffle=False: same order
+
+
 def test_abandoned_iteration_does_not_deadlock():
     class Slow:
         def __len__(self):
