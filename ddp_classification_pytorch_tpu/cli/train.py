@@ -178,6 +178,19 @@ def build_parser() -> argparse.ArgumentParser:
                         "supervise.sh + --auto_resume can recover (0 = off; "
                         "set WELL above the slowest compile — 900+ for "
                         "tunneled TPU, more for TResNet)")
+    r.add_argument("--max_bad_steps", type=int, default=-1,
+                   help="non-finite step sentinel: every train step skips "
+                        "its update (identity) when loss/grad-norm go "
+                        "NaN/Inf; after N CONSECUTIVE skips exit 8 "
+                        "('diverged' — deterministic, supervise.sh does "
+                        "not restart it). Default 25; 0 = skip forever, "
+                        "never exit")
+    r.add_argument("--fault_spec", default="",
+                   help="deterministic fault injection (utils/chaos.py), "
+                        "e.g. 'nan_loss@step=7..9,ckpt_io@epoch=1,"
+                        "loader_io@batch=3,sigterm@step=20'; "
+                        "CHAOS_FAULT_SPEC env overrides; see "
+                        "scripts/chaos_drill.sh")
     r.add_argument("--grad_accum", type=int, default=0,
                    help="microbatch accumulation factor")
     r.add_argument("--platform", default="", choices=["", "tpu", "cpu"],
@@ -349,6 +362,10 @@ def config_from_args(args: argparse.Namespace) -> Config:
         cfg.run.debug_nans = True
     if args.hang_timeout_s:
         cfg.run.hang_timeout_s = args.hang_timeout_s
+    if args.max_bad_steps >= 0:
+        cfg.run.max_bad_steps = args.max_bad_steps
+    if args.fault_spec:
+        cfg.run.fault_spec = args.fault_spec
     if args.grad_accum:
         cfg.parallel.grad_accum = args.grad_accum
 
@@ -485,7 +502,18 @@ def main(argv: Optional[Sequence[str]] = None) -> None:
         traceback.print_exc(file=sys.stderr)
         print(f"[trainer] config error: {e}", file=sys.stderr)
         raise SystemExit(2) from None
-    trainer.run()
+    from ..train.sentinel import SentinelDiverged
+
+    try:
+        trainer.run()
+    except SentinelDiverged as e:
+        import sys
+
+        # rc 8 = "diverged": max_bad_steps consecutive non-finite steps.
+        # Deterministic — the same weights replay the same divergence — so
+        # supervise.sh stops instead of burning the retry budget on it.
+        print(f"[trainer] diverged: {e}", file=sys.stderr)
+        raise SystemExit(SentinelDiverged.exit_code) from None
 
 
 if __name__ == "__main__":

@@ -256,6 +256,18 @@ class RunConfig:
     # above the slowest legitimate gap — first compile on a tunneled TPU can
     # take 10+ min (TResNet); 0 disables.
     hang_timeout_s: float = 0.0
+    # Non-finite step sentinel (train/sentinel.py): every jitted train step
+    # skips its update (identity) when loss/grad-norm go non-finite; after
+    # this many CONSECUTIVE skips the run exits rc 8 ("diverged") — a
+    # deterministic failure supervise.sh must NOT hot-loop restart. The
+    # streak is evaluated at the log_every sync cadence, so detection lands
+    # within one log window of the threshold. 0 = skip forever, never exit.
+    max_bad_steps: int = 25
+    # Deterministic fault injection (utils/chaos.py), e.g.
+    # "nan_loss@step=7,ckpt_io@epoch=1,loader_io@batch=3,sigterm@step=20".
+    # CHAOS_FAULT_SPEC env overrides; empty = every hook is inert and the
+    # train step compiles to exactly the uninjected program.
+    fault_spec: str = ""
 
 
 @dataclass

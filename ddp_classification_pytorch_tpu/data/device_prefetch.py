@@ -142,9 +142,14 @@ class DevicePrefetcher:
                 raise error[0]
         finally:
             stop.set()
-            # drain so a stager blocked on a full queue can exit
-            while t.is_alive():
+            # drain so a stager blocked on a full queue can exit, then JOIN
+            # it: generator close (the trainer loops' try/finally) must not
+            # return with a stager still staging H2D copies — a leaked
+            # thread would race the next epoch's pass (or a supervise.sh
+            # restart) for device memory
+            while True:
                 try:
                     q.get_nowait()
                 except queue.Empty:
                     break
+            t.join(timeout=10.0)

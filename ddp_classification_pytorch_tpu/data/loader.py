@@ -88,11 +88,16 @@ class ShardedLoader:
         host_id: Optional[int] = None,
         num_hosts: Optional[int] = None,
         batcher=None,
+        chaos=None,
     ):
         # batcher: optional native batch assembler
         # `(indices, epoch, batch_idx) -> (images, labels)` (see data/native.py);
         # replaces the per-sample Python/PIL path when set
         self.batcher = batcher
+        # chaos: optional utils.chaos.FaultPlan — loader_io faults raise
+        # IOError from _load_batch (the transient-crash shape supervise.sh
+        # retries with backoff); None = no injection code in the hot path
+        self.chaos = chaos
         self.dataset = dataset
         self.batch_size = batch_size
         self.shuffle = shuffle
@@ -177,6 +182,8 @@ class ShardedLoader:
         return (pos < len(self.dataset)).astype(np.float32)
 
     def _load_batch(self, batch_idx: int, indices: np.ndarray) -> Tuple[np.ndarray, np.ndarray]:
+        if self.chaos is not None:
+            self.chaos.maybe_fail_loader(epoch=self.epoch, batch=batch_idx)
         if self.batcher is not None:
             return self.batcher(indices, self.epoch, batch_idx)
 

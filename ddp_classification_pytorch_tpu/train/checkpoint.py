@@ -20,6 +20,7 @@ different mesh topology as long as shapes match.
 
 from __future__ import annotations
 
+import hashlib
 import json
 import os
 import re
@@ -28,7 +29,15 @@ from typing import Any, Optional, Tuple
 import jax
 from flax import serialization
 
-from ..utils.logging import is_host0
+from ..utils.logging import host0_print, is_host0
+
+
+def _sha256_file(path: str, chunk: int = 1 << 20) -> str:
+    h = hashlib.sha256()
+    with open(path, "rb") as f:
+        for block in iter(lambda: f.read(chunk), b""):
+            h.update(block)
+    return h.hexdigest()
 
 
 def _place_like(template: Any, restored: Any) -> Any:
@@ -113,11 +122,15 @@ class CheckpointManager:
         best_only: bool = False,
         keep: int = 0,
         async_save: bool = False,
+        chaos: Optional[Any] = None,
     ):
         self.out_dir = out_dir
         self.save_every_epoch = save_every_epoch
         self.best_only = best_only
         self.keep = keep  # 0 = keep all epoch checkpoints
+        # fault injection (utils/chaos.py): ckpt_io faults tear the landed
+        # file so the checksum-verified resume path can be drilled for real
+        self._chaos = chaos
         # async_save: serialize + write on a background thread so the train
         # loop keeps dispatching (the preemption-recovery posture SURVEY §5
         # calls for). device_get happens synchronously (cheap, and required
@@ -141,17 +154,65 @@ class CheckpointManager:
     def meta_path(self) -> str:
         return os.path.join(self.out_dir, "meta.json")
 
+    # ------------------------------------------------------------ checksum --
+    @staticmethod
+    def checksum_path(path: str) -> str:
+        return path + ".sha256"
+
+    def verify_checkpoint(self, path: str) -> str:
+        """'ok' | 'legacy' | 'corrupt' for a checkpoint file.
+
+        'legacy' = no sidecar (written before checksums existed, or the
+        process died between the checkpoint landing and its sidecar) —
+        accepted with a note, since the atomic write already rules out a
+        torn file from OUR writer. 'corrupt' = the sidecar exists and the
+        bytes don't hash to it (bit rot, a torn copy, or an injected
+        ckpt_io fault)."""
+        sidecar = self.checksum_path(path)
+        if not os.path.exists(sidecar):
+            return "legacy"
+        try:
+            with open(sidecar) as f:
+                expected = f.read().strip()
+        except OSError:
+            return "corrupt"
+        if not re.fullmatch(r"[0-9a-f]{64}", expected):
+            return "corrupt"
+        return "ok" if _sha256_file(path) == expected else "corrupt"
+
+    def _quarantine(self, path: str, reason: str) -> None:
+        """Rename a corrupt/torn checkpoint (and its sidecar) to *.corrupt
+        so it stops matching the epoch scan — the next restart must not
+        crash on it identically (that would brick --auto_resume). Kept on
+        disk, not deleted: post-mortem evidence."""
+        dst = path + ".corrupt"
+        try:
+            os.replace(path, dst)
+        except OSError:
+            return  # another host already moved it
+        sidecar = self.checksum_path(path)
+        if os.path.exists(sidecar):
+            try:
+                os.replace(sidecar, dst + ".sha256")
+            except OSError:
+                pass
+        host0_print(f"[ckpt] quarantined corrupt checkpoint {path} -> {dst} "
+                    f"({reason})")
+
     # ----------------------------------------------------------------- save --
     def _write_many(self, state: Any, paths, prune_after: bool = False,
                     meta_updates: Optional[dict] = None,
-                    host_state: Optional[Any] = None) -> None:
+                    host_state: Optional[Any] = None,
+                    epoch: Optional[int] = None) -> None:
         """One host transfer + one serialization, written to every path (a
-        new-best epoch writes the same bytes to ckpt_eN and ckpt_best).
-        `meta_updates` land AFTER the checkpoint bytes — meta must never
-        point at a checkpoint that has not hit disk yet. Callers on a
-        multi-host deployment pass `host_state` (gathered collectively on
-        every process by `_to_host`) since this method runs on host 0
-        only."""
+        new-best epoch writes the same bytes to ckpt_eN and ckpt_best),
+        each followed by its sha256 sidecar — sidecar strictly AFTER the
+        bytes, so a crash in between leaves a 'legacy' (accepted) file,
+        never an 'ok' verdict on unverified bytes. `meta_updates` land
+        after everything — meta must never point at a checkpoint that has
+        not hit disk yet. Callers on a multi-host deployment pass
+        `host_state` (gathered collectively on every process by
+        `_to_host`) since this method runs on host 0 only."""
         if host_state is None:
             # _to_host may be a cross-process collective, which this
             # host-0-only method must never trigger — a caller forgetting
@@ -163,11 +224,18 @@ class CheckpointManager:
 
         def serialize_and_write():
             data = serialization.to_bytes(host_state)
+            digest = hashlib.sha256(data).hexdigest()
             for path in paths:
                 tmp = path + ".tmp"
                 with open(tmp, "wb") as f:
                     f.write(data)
                 os.replace(tmp, path)  # atomic: no torn ckpts on preemption
+                if self._chaos is not None and epoch is not None:
+                    self._chaos.maybe_corrupt_checkpoint(path, epoch=epoch)
+                sc_tmp = self.checksum_path(path) + ".tmp"
+                with open(sc_tmp, "w") as f:
+                    f.write(digest + "\n")
+                os.replace(sc_tmp, self.checksum_path(path))
             if meta_updates:
                 self._write_meta(**meta_updates)
             if prune_after and self.keep > 0:
@@ -221,8 +289,10 @@ class CheckpointManager:
             with open(meta_path) as f:
                 try:
                     return json.load(f)
-                except json.JSONDecodeError:
-                    # legacy torn file (pre-atomic-write runs): resuming
+                except ValueError:
+                    # legacy torn file (pre-atomic-write runs): truncated
+                    # JSON raises JSONDecodeError, binary garbage raises
+                    # UnicodeDecodeError — both are ValueError, and resuming
                     # with default meta beats crashing every retry
                     return {}
         return {}
@@ -267,7 +337,7 @@ class CheckpointManager:
             # meta rides with the write so it lands strictly after the bytes
             self._write_many(state, paths, prune_after=True,
                              meta_updates=meta_updates,
-                             host_state=host_state)
+                             host_state=host_state, epoch=epoch)
         else:
             self._write_meta(**meta_updates)
         return is_best
@@ -276,6 +346,9 @@ class CheckpointManager:
         have = sorted(self._epoch_checkpoints())
         for e in have[: max(len(have) - self.keep, 0)]:
             os.remove(self.epoch_path(e))
+            sidecar = self.checksum_path(self.epoch_path(e))
+            if os.path.exists(sidecar):
+                os.remove(sidecar)
 
     def _epoch_checkpoints(self):
         if not os.path.isdir(self.out_dir):
@@ -288,30 +361,71 @@ class CheckpointManager:
         return out
 
     # -------------------------------------------------------------- restore --
-    def restore(self, template_state: Any, path: str) -> Any:
+    def restore(self, template_state: Any, path: str, verify: bool = True) -> Any:
         """Collective-free: the from_bytes target is a numpy skeleton, so a
         single host can restore without the others. On multi-host runs
         `out_dir` must be visible to every host (shared filesystem or
         per-host copies) — hosts that miss the file would silently keep
-        the template values."""
+        the template values.
+
+        An explicitly named checkpoint failing its sha256 sidecar raises
+        ValueError (config-shaped: the CLI maps it to the deterministic
+        rc 2 — resuming from a named corrupt file fails identically every
+        time, so the supervisor must not retry it). The quarantine-and-
+        fall-back policy lives in `restore_latest` (--auto_resume) only."""
+        if verify and self.verify_checkpoint(path) == "corrupt":
+            raise ValueError(
+                f"checkpoint {path} does not match its sha256 sidecar "
+                f"({self.checksum_path(path)}) — corrupt or torn; use "
+                "--auto_resume to fall back to the newest verified "
+                "checkpoint, or delete the file")
         with open(path, "rb") as f:
             restored = serialization.from_bytes(
                 _host_skeleton(template_state), f.read())
         return _place_like(template_state, restored)
 
+    def _restore_verified(self, template_state: Any, path: str) -> Optional[Any]:
+        """Restore `path` iff it passes checksum + deserialization;
+        quarantine it and return None otherwise (auto-resume then falls
+        back to the next-newest candidate instead of crashing every
+        restart identically on the same bad file)."""
+        if not os.path.exists(path):
+            return None  # lost a quarantine race with another host
+        status = self.verify_checkpoint(path)
+        if status == "corrupt":
+            self._quarantine(path, "sha256 mismatch")
+            return None
+        if status == "legacy":
+            host0_print(f"[ckpt] no sha256 sidecar for {path} "
+                        "(pre-checksum checkpoint); accepting")
+        try:
+            return self.restore(template_state, path, verify=False)
+        except (OSError, ValueError, KeyError, EOFError) as e:
+            # a pre-checksum torn file (or one torn together with its
+            # sidecar) fails deserialization instead of verification
+            self._quarantine(path, f"deserialization failed: {e}")
+            return None
+
     def restore_latest(self, template_state: Any) -> Tuple[Any, int]:
-        """(state, next_epoch). next_epoch = 0 when nothing to restore."""
+        """(state, next_epoch). next_epoch = 0 when nothing to restore.
+
+        Integrity-verified: candidates are tried newest-first; a corrupt
+        or torn one is quarantined (renamed *.corrupt) and the next-newest
+        VERIFIED checkpoint wins — a bad latest checkpoint costs one epoch
+        of progress, not the whole retry budget."""
         self.wait()
-        epochs = self._epoch_checkpoints()
-        if epochs:
-            last = max(epochs)
+        for e in sorted(self._epoch_checkpoints(), reverse=True):
+            state = self._restore_verified(template_state, self.epoch_path(e))
+            if state is None:
+                continue
             # resume best-tracking too, or the first post-resume epoch would
             # clobber ckpt_best regardless of its metric
             self.best_metric = self.read_meta().get("best_metric", float("-inf"))
-            return self.restore(template_state, self.epoch_path(last)), last + 1
+            return state, e + 1
         if os.path.exists(self.best_path):
-            meta = self.read_meta()
-            state = self.restore(template_state, self.best_path)
-            self.best_metric = meta.get("best_metric", float("-inf"))
-            return state, int(meta.get("best_epoch", -1)) + 1
+            state = self._restore_verified(template_state, self.best_path)
+            if state is not None:
+                meta = self.read_meta()
+                self.best_metric = meta.get("best_metric", float("-inf"))
+                return state, int(meta.get("best_epoch", -1)) + 1
         return template_state, 0

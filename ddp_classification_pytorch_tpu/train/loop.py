@@ -45,9 +45,11 @@ from ..data.synthetic import SyntheticDataset
 from ..data.transforms import build_transform
 from ..ops.nested import best_k
 from ..parallel import mesh as meshlib
+from ..utils import chaos as chaoslib
 from ..utils.backend_probe import StepHeartbeat
 from ..utils.logging import EtaLogger, RecordWriter, host0_print, is_host0
 from .checkpoint import CheckpointManager
+from .sentinel import StepSentinel
 from .state import create_train_state, param_count
 from .steps import make_eval_step, make_nested_eval_step, make_train_step
 
@@ -154,6 +156,16 @@ class Trainer:
         # compile included — see RunConfig.hang_timeout_s).
         self._heartbeat = StepHeartbeat(
             cfg.run.hang_timeout_s, where=f"trainer[{cfg.workload}]").start()
+        # fault injection (off unless run.fault_spec / CHAOS_FAULT_SPEC):
+        # one-shot state persists under <out_dir>/chaos so a supervised
+        # restart does not replay host-side faults. A malformed spec raises
+        # ValueError here — construction-time, so the CLI maps it to rc 2.
+        self.chaos = chaoslib.plan_for_run(cfg.run.fault_spec, cfg.run.out_dir)
+        if self.chaos:
+            host0_print(f"[chaos] fault plan active: {self.chaos}")
+        # non-finite step policy: skip counting + rc-8 escalation
+        # (train/sentinel.py); the streak carries across epochs
+        self.sentinel = StepSentinel(cfg.run.max_bad_steps)
         if train_ds is None:
             train_ds, val_ds = build_datasets(cfg)
         self.train_ds, self.val_ds = train_ds, val_ds
@@ -180,7 +192,7 @@ class Trainer:
         self.train_loader = ShardedLoader(
             train_ds, cfg.data.batch_size, shuffle=True, seed=cfg.run.seed,
             num_workers=cfg.data.num_workers, prefetch=cfg.data.prefetch,
-            batcher=train_batcher)
+            batcher=train_batcher, chaos=self.chaos or None)
         self.val_loader = ShardedLoader(
             val_ds, cfg.data.batch_size, shuffle=False, seed=cfg.run.seed,
             num_workers=cfg.data.num_workers, prefetch=cfg.data.prefetch,
@@ -191,7 +203,8 @@ class Trainer:
             cfg, self.mesh, self.steps_per_epoch)
 
         self.train_step = make_train_step(cfg, self.model, self.tx,
-                                          mesh=self.mesh)
+                                          mesh=self.mesh,
+                                          chaos=self.chaos or None)
         self.eval_step = make_eval_step(cfg, self.model, mesh=self.mesh)
         self.nested_eval_step = (
             make_nested_eval_step(cfg, self.model)
@@ -211,6 +224,7 @@ class Trainer:
             best_only=cfg.run.save_best_only,
             keep=cfg.run.keep_checkpoints,
             async_save=cfg.run.async_checkpoint,
+            chaos=self.chaos or None,
         )
         self.start_epoch = 0
         if cfg.run.resume:
@@ -235,6 +249,10 @@ class Trainer:
         if self.records is not None and self.native_dataplane:
             # the committed record itself proves which input path fed the run
             self.records.append_txt("# native C++ dataplane active")
+
+        # host-side mirror of the global step counter (coordinates for the
+        # sigterm fault hook): one device sync at init, then pure counting
+        self._host_step = int(self.state.step) if self.chaos else 0
 
         host0_print(
             f"[trainer] workload={cfg.workload} arch={cfg.model.arch} "
@@ -286,22 +304,41 @@ class Trainer:
         self.train_loader.set_epoch(epoch)
         sums = None  # device-side accumulation: no per-step host sync, so the
         n_batches = 0  # host keeps dispatching ahead of the device
-        for step, batch in enumerate(self._device_prefetcher(self.train_loader)):
-            self._maybe_profile_start(epoch, step)
-            self.state, metrics = self.train_step(self.state, *batch)
-            self._maybe_profile_stop(epoch, step, metrics)
-            n_batches += 1
-            sums = metrics if sums is None else jax.tree_util.tree_map(
-                jax.numpy.add, sums, metrics)
-            if eta is not None and step % self.cfg.run.log_every == 0:
-                # the only host sync per log_every steps (reference syncs
-                # .item() on the same cadence, BASELINE/main.py:284-303)
-                eta.maybe_log(epoch, step, **{k: float(v) for k, v in metrics.items()})
-                # the float() above is a real device round-trip, so reaching
-                # here is proof the backend is answering — heartbeat it
-                self._heartbeat.touch()
+        it = iter(self._device_prefetcher(self.train_loader))
+        try:
+            for step, batch in enumerate(it):
+                self._maybe_profile_start(epoch, step)
+                self.state, metrics = self.train_step(self.state, *batch)
+                self._maybe_profile_stop(epoch, step, metrics)
+                n_batches += 1
+                sums = metrics if sums is None else jax.tree_util.tree_map(
+                    jax.numpy.add, sums, metrics)
+                # device scalar only — the sentinel syncs it at flush points
+                self.sentinel.observe(metrics["step_ok"])
+                if self.chaos:
+                    self._host_step += 1
+                    self.chaos.maybe_sigterm(step=self._host_step - 1)
+                if step % self.cfg.run.log_every == 0:
+                    if eta is not None:
+                        # the only host sync per log_every steps (reference
+                        # syncs .item() on the same cadence, BASELINE:284-303)
+                        eta.maybe_log(epoch, step,
+                                      **{k: float(v) for k, v in metrics.items()})
+                    # flush is a device round-trip too, so reaching here is
+                    # proof the backend is answering — heartbeat it. It also
+                    # raises SentinelDiverged on a sustained-NaN streak.
+                    self.sentinel.flush()
+                    self._heartbeat.touch()
+        finally:
+            # a mid-epoch exception (divergence, injected fault, loader IO)
+            # must stop and join the stager thread — a leaked stager would
+            # keep the old epoch's H2D copies running across a supervise.sh
+            # restart
+            it.close()
+        self.sentinel.flush()
         if sums is None:
-            return {"loss": 0.0, "top1": 0.0, "top3": 0.0}
+            return {"loss": 0.0, "top1": 0.0, "top3": 0.0,
+                    "step_ok": 1.0, "grad_norm": 0.0}
         out = {k: float(v) / n_batches for k, v in sums.items()}  # host sync
         self._heartbeat.touch()
         return out
@@ -320,11 +357,15 @@ class Trainer:
             return self._evaluate_nested()
         totals = None  # device-side accumulation: a float() per batch would
         # serialize eval dispatch (4 device-gets/batch); sync once at the end
-        for batch in self._device_prefetcher(self.val_loader,
-                                             assemble=self._stage_eval_batch):
-            out = self.eval_step(self.state, *batch)
-            totals = out if totals is None else jax.tree_util.tree_map(
-                jax.numpy.add, totals, out)
+        it = iter(self._device_prefetcher(self.val_loader,
+                                          assemble=self._stage_eval_batch))
+        try:
+            for batch in it:
+                out = self.eval_step(self.state, *batch)
+                totals = out if totals is None else jax.tree_util.tree_map(
+                    jax.numpy.add, totals, out)
+        finally:
+            it.close()  # stop + join the stager on a mid-eval exception
         if totals is None:
             return {"val_loss": 0.0, "val_top1": 0.0, "val_top3": 0.0}
         totals = {k: float(v) for k, v in totals.items()}  # the one host sync
@@ -338,12 +379,16 @@ class Trainer:
 
     def _evaluate_nested(self) -> Dict[str, float]:
         t1 = t3 = n_dev = None  # accumulate on device; one sync at the end
-        for batch in self._device_prefetcher(self.val_loader,
-                                             assemble=self._stage_eval_batch):
-            out = self.nested_eval_step(self.state, *batch)
-            t1 = out["top1_k"] if t1 is None else t1 + out["top1_k"]
-            t3 = out["top3_k"] if t3 is None else t3 + out["top3_k"]
-            n_dev = out["n"] if n_dev is None else n_dev + out["n"]
+        it = iter(self._device_prefetcher(self.val_loader,
+                                          assemble=self._stage_eval_batch))
+        try:
+            for batch in it:
+                out = self.nested_eval_step(self.state, *batch)
+                t1 = out["top1_k"] if t1 is None else t1 + out["top1_k"]
+                t3 = out["top3_k"] if t3 is None else t3 + out["top3_k"]
+                n_dev = out["n"] if n_dev is None else n_dev + out["n"]
+        finally:
+            it.close()  # stop + join the stager on a mid-eval exception
         if t1 is None:  # val set smaller than one global batch
             return {"val_top1": 0.0, "val_top3": 0.0, "best_k": 0}
         n = float(n_dev)  # host sync

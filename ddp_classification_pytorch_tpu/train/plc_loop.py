@@ -161,8 +161,9 @@ class PLCTrainer(Trainer):
             loader,
             assemble=lambda i, hb: meshlib.make_global_array(hb[0], self.mesh))
         local_chunks = []  # this host's rows of each global batch
+        it = iter(prefetcher)
         try:
-            for global_images in prefetcher:
+            for global_images in it:
                 logits = self.predict_step(self.state, global_images)
                 # gather ONLY the addressable (this-host) shard rows — exact on
                 # any pod topology, no cross-host transfer. Dedup by row range:
@@ -173,6 +174,7 @@ class PLCTrainer(Trainer):
                 local_chunks.append(np.concatenate(
                     [np.asarray(by_start[k].data) for k in sorted(by_start)]))
         finally:
+            it.close()  # stop + join the stager on a mid-pass exception
             loader.close()  # per-epoch loader: release its worker pool now
         local = np.concatenate(local_chunks, axis=0)
 

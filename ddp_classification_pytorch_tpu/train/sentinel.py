@@ -1,0 +1,82 @@
+"""Host-side policy over the in-jit non-finite step check.
+
+The jitted train step (steps.py::_build_step) guards every update with a
+cheap on-device all-finite check — loss plus global grad norm — and
+applies the IDENTITY update when the check fails, so one diverged batch
+cannot poison the weights (AMP-style skip-step semantics). The `step_ok`
+flag and `grad_norm` ride the existing per-step metrics dict, so the
+check costs no extra host sync.
+
+This module is the policy layer on top of that flag:
+
+- `StepSentinel.observe` collects the per-step device flags without
+  syncing them;
+- `StepSentinel.flush` — called where the loop already syncs (the
+  log-line cadence and epoch end) — converts the window to host floats,
+  counts skips, logs them, and raises `SentinelDiverged` after
+  `run.max_bad_steps` CONSECUTIVE skips: at that point the identity
+  update is not recovering (real divergence, not a transient), and
+  restarting would deterministically replay it. The CLI maps the
+  exception to rc 8, which scripts/supervise.sh classifies as
+  deterministic (no hot-loop restart burning the retry budget).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, List
+
+from ..utils.logging import host0_print
+
+
+class SentinelDiverged(RuntimeError):
+    """Training diverged: max_bad_steps consecutive non-finite steps.
+
+    `exit_code` is the process-level contract — cli.train maps this to
+    SystemExit(8) and supervise.sh stops instead of restarting."""
+
+    exit_code = 8
+
+
+class StepSentinel:
+    """Counts skipped (non-finite) train steps and escalates sustained
+    divergence. One instance per Trainer: the consecutive-skip streak
+    deliberately carries across epoch boundaries."""
+
+    def __init__(self, max_bad_steps: int,
+                 log: Callable[[str], None] = host0_print):
+        self.max_bad_steps = int(max_bad_steps)
+        self.skipped_total = 0
+        self.streak = 0  # consecutive skips, across flush windows/epochs
+        self._log = log
+        self._pending: List[Any] = []  # device scalars, not yet synced
+
+    def observe(self, step_ok: Any) -> None:
+        """Record one step's `step_ok` flag (a device scalar — NOT synced
+        here; the device keeps running ahead)."""
+        self._pending.append(step_ok)
+
+    def flush(self) -> None:
+        """Sync the pending window and apply policy. Call on the loop's
+        existing host-sync points. Raises SentinelDiverged when the
+        consecutive-skip streak reaches max_bad_steps."""
+        if not self._pending:
+            return
+        pending, self._pending = self._pending, []
+        window_skips = 0
+        for ok in pending:
+            if float(ok) >= 0.5:
+                self.streak = 0
+            else:
+                self.streak += 1
+                self.skipped_total += 1
+                window_skips += 1
+        if window_skips:
+            self._log(f"[sentinel] skipped {window_skips} non-finite "
+                      f"step(s) (total {self.skipped_total}, "
+                      f"consecutive {self.streak})")
+        if 0 < self.max_bad_steps <= self.streak:
+            raise SentinelDiverged(
+                f"{self.streak} consecutive non-finite steps "
+                f"(max_bad_steps={self.max_bad_steps}) — the skip-step "
+                "guard is not recovering; loss/gradients are NaN/Inf "
+                "every step (rc 8: deterministic, do not restart)")

@@ -62,6 +62,7 @@ def make_train_step(
     tx: optax.GradientTransformationExtraArgs,
     base_rng: Optional[jax.Array] = None,
     mesh: Optional[Any] = None,
+    chaos: Optional[Any] = None,
 ) -> Callable[[TrainState, jnp.ndarray, jnp.ndarray], Tuple[TrainState, Dict[str, jnp.ndarray]]]:
     """One jitted `(state, images, labels) -> (state, metrics)` for the
     workload in `cfg` (baseline/cdr: plain CE; arcface: margin logits;
@@ -70,7 +71,11 @@ def make_train_step(
     With `parallel.arcface_sharded_ce` (and a model axis > 1), the ArcFace
     loss runs the partial-FC path: embeddings + class-sharded weight feed
     `ops.sharded_head.arc_margin_ce_sharded`, so no (B, C) logits exist —
-    `mesh` is required for that mode."""
+    `mesh` is required for that mode.
+
+    `chaos` (utils/chaos.py FaultPlan): nan_loss faults poison the loss on
+    their step windows inside jit — the staged version of a real
+    divergence, which the step's non-finite guard must absorb."""
     workload = cfg.model.head
     if base_rng is None:
         base_rng = jax.random.PRNGKey(cfg.run.seed + 1)
@@ -78,7 +83,7 @@ def make_train_step(
     if cfg.parallel.arcface_sharded_ce and workload == "arcface":
         _require_sharded_ce_mesh(mesh)
         loss_fn, metrics_fn = _arcface_sharded_loss(cfg, model, mesh)
-        return _build_step(tx, base_rng, loss_fn, metrics_fn)
+        return _build_step(tx, base_rng, loss_fn, metrics_fn, chaos=chaos)
 
     if workload == "nested":
         dist = jnp.asarray(gaussian_dist(0.0, cfg.model.nested_std, feat_dim_for(cfg.model)))
@@ -106,7 +111,8 @@ def make_train_step(
         return loss, (mutated.get("batch_stats", batch_stats), logits)
 
     return _build_step(tx, base_rng, loss_fn,
-                       lambda loss, logits, labels: _train_metrics(loss, logits, labels))
+                       lambda loss, logits, labels: _train_metrics(loss, logits, labels),
+                       chaos=chaos)
 
 
 def _require_sharded_ce_mesh(mesh) -> None:
@@ -123,26 +129,56 @@ def _require_sharded_ce_mesh(mesh) -> None:
             + ("no mesh" if mesh is None else f"mesh {dict(mesh.shape)}"))
 
 
-def _build_step(tx, base_rng, loss_fn, metrics_fn):
+def _build_step(tx, base_rng, loss_fn, metrics_fn, chaos=None):
     """Shared optimizer-update skeleton for every train step: fold_in rng,
     value_and_grad over `loss_fn(params, stats, images, labels, rng) ->
     (loss, (new_stats, aux))`, apply updates, metrics via
-    `metrics_fn(loss, aux, labels)`."""
+    `metrics_fn(loss, aux, labels)`.
+
+    Non-finite guard (AMP-style skip-step): every update is gated on an
+    on-device all-finite check of the loss AND the global grad norm. A
+    failing step applies the IDENTITY update — params, optimizer state,
+    and BN statistics keep their previous values (elementwise select, so
+    a passing step is bit-identical to the unguarded update) while the
+    step counter still advances (the rng/schedule stream moves on, so a
+    restart-free retry of the next batch is not a deterministic replay).
+    The `step_ok` flag and `grad_norm` ride the existing metrics fetch —
+    no extra host sync; train/sentinel.py applies host-side policy.
+
+    `chaos` nan_loss windows poison the loss AFTER value_and_grad (the
+    guard sees NaN, gradients stay untouched), keeping injection
+    bit-transparent outside its windows."""
+    nan_windows = list(chaos.windows("nan_loss", "step")) if chaos else []
 
     def step(state: TrainState, images: jnp.ndarray, labels: jnp.ndarray):
         rng = jax.random.fold_in(base_rng, state.step)
         (loss, (new_stats, aux)), grads = jax.value_and_grad(loss_fn, has_aux=True)(
             state.params, state.batch_stats, images, labels, rng
         )
+        for lo, hi in nan_windows:
+            hit = state.step >= lo
+            if hi is not None:
+                hit &= state.step <= hi
+            loss = jnp.where(hit, jnp.asarray(jnp.nan, loss.dtype), loss)
+        grad_norm = optax.global_norm(grads)
+        step_ok = jnp.isfinite(loss) & jnp.isfinite(grad_norm)
         updates, new_opt = tx.update(grads, state.opt_state, state.params)
         new_params = optax.apply_updates(state.params, updates)
+
+        def keep(new, old):
+            return jax.tree_util.tree_map(
+                lambda n, o: jnp.where(step_ok, n, o), new, old)
+
         new_state = state.replace(
             step=state.step + 1,
-            params=new_params,
-            batch_stats=new_stats,
-            opt_state=new_opt,
+            params=keep(new_params, state.params),
+            batch_stats=keep(new_stats, state.batch_stats),
+            opt_state=keep(new_opt, state.opt_state),
         )
-        return new_state, metrics_fn(loss, aux, labels)
+        metrics = metrics_fn(loss, aux, labels)
+        metrics["step_ok"] = step_ok.astype(jnp.float32)
+        metrics["grad_norm"] = grad_norm
+        return new_state, metrics
 
     return jax.jit(step, donate_argnums=0)
 

@@ -6,9 +6,30 @@ import os
 
 
 def enable_persistent_cache(min_compile_secs: float = 2.0) -> None:
-    """Repeat runs skip the 20-40s XLA compiles. Safe no-op on older jax."""
+    """Repeat runs skip the 20-40s XLA compiles. Safe no-op on older jax.
+
+    CPU is excluded. Observed live (2026-08-04, chaos drill + preemption
+    test, deterministic across repeats): an executable DESERIALIZED from
+    the persistent cache by a later CPU process computed NaN where the
+    freshly compiled executable of the same HLO was finite — the restored
+    state was bit-verified identical and the first step's metrics matched
+    exactly, then the next step's gradients went NaN — and one such
+    process segfaulted at teardown. CPU compiles are seconds, so the
+    cache buys little there; it stays on for the TPU plugin, whose
+    multi-minute compiles it exists to skip.
+
+    The platform check reads config/env only — it must not trigger the
+    first backend initialization (callers sequence that carefully under
+    the init watchdog)."""
     import jax
 
+    try:
+        platforms = jax.config.jax_platforms or ""
+    except AttributeError:
+        platforms = ""
+    platforms = platforms or os.environ.get("JAX_PLATFORMS", "")
+    if platforms.split(",")[0].strip().lower() == "cpu":
+        return
     cache_dir = os.environ.get(
         "JAX_COMPILATION_CACHE_DIR",
         os.path.join(os.path.expanduser("~"), ".cache", "ddp_tpu_xla_cache"))

@@ -1,0 +1,231 @@
+"""Deterministic fault injection for the recovery chain.
+
+The supervisor stack (scripts/supervise.sh rc classification, the init
+watchdog + StepHeartbeat in `utils/backend_probe.py`, atomic checkpoint
+writes and checksum-verified resume in `train/checkpoint.py`, and the
+non-finite step sentinel in `train/sentinel.py`) exists to survive
+failures that are, by nature, rare and hard to stage. This module makes
+them stageable: a `FaultPlan` parsed from a spec string like
+
+    nan_loss@step=7,ckpt_io@epoch=1,loader_io@batch=3,sigterm@step=20
+
+drives injection hooks planted at four points:
+
+- ``nan_loss`` — the jitted train step poisons the loss to NaN on the
+  matching global steps (train/steps.py). Purely a function of the step
+  counter, so it re-fires identically across restarts — exactly what a
+  real divergence does — and the sentinel's skip/rollback is what must
+  absorb it.
+- ``ckpt_io`` — the checkpoint write for the matching epoch is torn
+  (the landed file is truncated AFTER its sha256 sidecar was computed),
+  so `--auto_resume` must quarantine it and fall back.
+- ``loader_io`` — the data loader raises ``IOError`` on the matching
+  batch/epoch, the transient-crash shape supervise.sh retries (rc 1).
+- ``sigterm`` — the step loop SIGTERMs its own process on the matching
+  global step: a mid-epoch preemption.
+
+Ranges: ``@step=7`` (one step), ``@step=7..9`` (inclusive), ``@step=7..``
+(every step from 7 on). Host-side faults (ckpt_io / loader_io / sigterm)
+fire AT MOST ONCE per fault — in-process, and across restarts when a
+``state_dir`` is given (a marker file per fired fault), so a supervised
+run converges to a clean exit instead of deterministically replaying the
+injected crash. The spec is env-overridable (``CHAOS_FAULT_SPEC``) so a
+drill can wrap any existing launch script unchanged.
+
+An empty/absent spec parses to a falsy plan and every call site gates on
+it, so production runs take bit-for-bit the code path they take today
+(tests/test_chaos.py pins this for the jitted step).
+"""
+
+from __future__ import annotations
+
+import os
+import signal
+import sys
+from dataclasses import dataclass
+from typing import List, Optional, Tuple
+
+KINDS = ("nan_loss", "ckpt_io", "loader_io", "sigterm")
+UNITS = ("step", "epoch", "batch")
+
+ENV_SPEC = "CHAOS_FAULT_SPEC"
+ENV_STATE_DIR = "CHAOS_STATE_DIR"
+
+
+def resolve_spec(config_spec: str = "") -> str:
+    """The active fault spec: ``CHAOS_FAULT_SPEC`` wins over the config
+    value so a drill can wrap an existing launch script unchanged."""
+    return os.environ.get(ENV_SPEC) or (config_spec or "")
+
+
+@dataclass(frozen=True)
+class Fault:
+    kind: str  # one of KINDS
+    unit: str  # one of UNITS
+    lo: int
+    hi: Optional[int]  # None = open-ended range
+
+    def matches(self, value: int) -> bool:
+        return value >= self.lo and (self.hi is None or value <= self.hi)
+
+    @property
+    def key(self) -> str:
+        """Filesystem-safe identity for fired-marker files."""
+        hi = "inf" if self.hi is None else str(self.hi)
+        return f"{self.kind}.{self.unit}.{self.lo}-{hi}"
+
+    def __str__(self) -> str:
+        if self.hi == self.lo:
+            rng = str(self.lo)
+        elif self.hi is None:
+            rng = f"{self.lo}.."
+        else:
+            rng = f"{self.lo}..{self.hi}"
+        return f"{self.kind}@{self.unit}={rng}"
+
+
+def _parse_range(text: str) -> Tuple[int, Optional[int]]:
+    if ".." in text:
+        lo_s, hi_s = text.split("..", 1)
+        lo = int(lo_s)
+        hi = int(hi_s) if hi_s else None
+        if hi is not None and hi < lo:
+            raise ValueError(f"empty fault range {text!r}")
+        return lo, hi
+    v = int(text)
+    return v, v
+
+
+class FaultPlan:
+    """Parsed fault spec + one-shot firing state for the host-side hooks.
+
+    Falsy when empty — call sites gate on the plan so an absent spec costs
+    nothing and changes nothing.
+    """
+
+    def __init__(self, faults: List[Fault], state_dir: Optional[str] = None):
+        self.faults = list(faults)
+        self.state_dir = state_dir
+        self._fired: set = set()
+
+    @classmethod
+    def parse(cls, spec: str, state_dir: Optional[str] = None) -> "FaultPlan":
+        """``kind@unit=range[,kind@unit=range...]`` → FaultPlan.
+
+        Raises ValueError on malformed specs — surfaced at trainer
+        construction, which the CLI maps to the deterministic rc 2.
+        """
+        state_dir = os.environ.get(ENV_STATE_DIR) or state_dir
+        faults: List[Fault] = []
+        for part in (spec or "").split(","):
+            part = part.strip()
+            if not part:
+                continue
+            try:
+                kind, cond = part.split("@", 1)
+                unit, rng = cond.split("=", 1)
+                lo, hi = _parse_range(rng.strip())
+            except ValueError:
+                raise ValueError(
+                    f"malformed fault {part!r} (want kind@unit=N, "
+                    "kind@unit=N..M, or kind@unit=N..)") from None
+            kind, unit = kind.strip(), unit.strip()
+            if kind not in KINDS:
+                raise ValueError(f"unknown fault kind {kind!r}; one of {KINDS}")
+            if unit not in UNITS:
+                raise ValueError(f"unknown fault unit {unit!r}; one of {UNITS}")
+            if kind == "nan_loss" and unit != "step":
+                raise ValueError("nan_loss is keyed by the in-jit step "
+                                 "counter; use nan_loss@step=...")
+            faults.append(Fault(kind, unit, lo, hi))
+        return cls(faults, state_dir=state_dir)
+
+    def __bool__(self) -> bool:
+        return bool(self.faults)
+
+    def __str__(self) -> str:
+        return ",".join(str(f) for f in self.faults)
+
+    # --------------------------------------------------------------- state --
+    def _marker(self, fault: Fault) -> Optional[str]:
+        return (os.path.join(self.state_dir, fault.key)
+                if self.state_dir else None)
+
+    def _already_fired(self, fault: Fault) -> bool:
+        if fault.key in self._fired:
+            return True
+        m = self._marker(fault)
+        return m is not None and os.path.exists(m)
+
+    def _mark_fired(self, fault: Fault) -> None:
+        """Record the firing BEFORE the fault takes effect: a fault that
+        kills the process must not re-fire on the supervised restart."""
+        self._fired.add(fault.key)
+        m = self._marker(fault)
+        if m is not None:
+            os.makedirs(self.state_dir, exist_ok=True)
+            with open(m, "w") as f:
+                f.write(str(fault) + "\n")
+
+    def should_fire(self, kind: str, **coords: int) -> Optional[Fault]:
+        """One-shot host-side trigger: the first un-fired fault of `kind`
+        whose unit is present in `coords` and whose range matches. Marks
+        it fired (in memory, and in state_dir when configured) before
+        returning it."""
+        for f in self.faults:
+            if (f.kind == kind and f.unit in coords
+                    and f.matches(int(coords[f.unit]))
+                    and not self._already_fired(f)):
+                self._mark_fired(f)
+                return f
+        return None
+
+    # ------------------------------------------------------------ windows --
+    def windows(self, kind: str, unit: str = "step") -> List[Tuple[int, Optional[int]]]:
+        """(lo, hi) ranges for in-jit injection (hi None = open-ended).
+        NOT one-shot: a pure function of the step counter, like a real
+        divergence."""
+        return [(f.lo, f.hi) for f in self.faults
+                if f.kind == kind and f.unit == unit]
+
+    # -------------------------------------------------------------- hooks --
+    def maybe_fail_loader(self, *, epoch: int, batch: int) -> None:
+        """Loader-read hook (data/loader.py::ShardedLoader._load_batch)."""
+        f = self.should_fire("loader_io", epoch=epoch, batch=batch)
+        if f is not None:
+            raise IOError(f"chaos: injected loader failure ({f}) "
+                          f"at epoch={epoch} batch={batch}")
+
+    def maybe_corrupt_checkpoint(self, path: str, *, epoch: int) -> bool:
+        """Checkpoint-write hook (train/checkpoint.py): tears the landed
+        file by truncating it to half its bytes — the sha256 sidecar
+        (computed from the intact serialization) then fails verification
+        on resume. Returns True when it fired."""
+        f = self.should_fire("ckpt_io", epoch=epoch)
+        if f is None:
+            return False
+        size = os.path.getsize(path)
+        with open(path, "r+b") as fh:
+            fh.truncate(max(size // 2, 1))
+        print(f"# chaos: tore checkpoint {path} ({f}): "
+              f"{size} -> {max(size // 2, 1)} bytes", file=sys.stderr, flush=True)
+        return True
+
+    def maybe_sigterm(self, *, step: int) -> None:
+        """Step-loop hook (train/loop.py): a mid-epoch preemption."""
+        f = self.should_fire("sigterm", step=step)
+        if f is not None:
+            print(f"# chaos: SIGTERM self at step {step} ({f})",
+                  file=sys.stderr, flush=True)
+            os.kill(os.getpid(), signal.SIGTERM)
+
+
+def plan_for_run(config_spec: str, out_dir: str) -> FaultPlan:
+    """The trainer's entry point: resolve the spec (env wins), persist
+    one-shot firing state under ``<out_dir>/chaos`` so a supervised
+    restart does not replay host-side faults (``CHAOS_STATE_DIR``
+    overrides the location)."""
+    spec = resolve_spec(config_spec)
+    if not spec:
+        return FaultPlan([])
+    return FaultPlan.parse(spec, state_dir=os.path.join(out_dir, "chaos"))

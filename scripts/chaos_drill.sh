@@ -1,0 +1,71 @@
+#!/usr/bin/env bash
+# End-to-end chaos drill: prove the recovery chain — supervise.sh restarts,
+# --auto_resume with checksum-verified fallback, the non-finite step
+# sentinel, and rc classification — against INJECTED faults instead of
+# trusting it (docs/operations.md "Chaos drill").
+#
+# Phase 1 (must converge to rc 0): a NaN-loss burst (skipped by the
+# sentinel), a loader IO failure (rc 1, restarted with backoff), a torn
+# epoch-0 checkpoint (quarantined on resume, fallback to fresh start), and
+# a mid-epoch SIGTERM (restarted fast). Host-side faults are one-shot
+# across restarts (fired markers under $OUT/chaos), so the run converges.
+#
+# Phase 2 (must stop at rc 8): a sustained NaN from step 2 on — the
+# sentinel exits 8 ("diverged") and supervise.sh must NOT restart it.
+#
+# CPU-only, synthetic data, tiny model: runs anywhere in a few minutes.
+# Usage: bash scripts/chaos_drill.sh [out_dir]
+set -u
+REPO=$(cd "$(dirname "$0")/.." && pwd)
+OUT=${1:-"$REPO/runs/chaos_drill"}
+export JAX_PLATFORMS=cpu
+
+COMMON=(baseline --dataset synthetic --platform cpu --model resnet18
+        --variant cifar --dtype float32 --image_size 32 --num_classes 4
+        --batchsize 64 --num_workers 1 --log_every 2 --epochs 3)
+
+fail() { echo "CHAOS DRILL FAIL: $*" >&2; exit 1; }
+
+# ---------------------------------------------------------------- phase 1 --
+P1="$OUT/converge"
+rm -rf "$P1"; mkdir -p "$P1"
+SPEC1="nan_loss@step=2..3,loader_io@batch=5,ckpt_io@epoch=0,sigterm@step=12"
+echo "[drill] phase 1: $SPEC1"
+MAX_RESTARTS=5 RUNTIME_BACKOFF_S=1 \
+  bash "$REPO/scripts/supervise.sh" "${COMMON[@]}" \
+    --out "$P1" --fault_spec "$SPEC1" 2>&1 | tee "$P1/drill.log"
+rc=${PIPESTATUS[0]}
+
+[ "$rc" -eq 0 ] || fail "phase 1 exited rc=$rc, want 0 (see $P1/drill.log)"
+grep -q "\[sentinel\] skipped" "$P1/drill.log" \
+  || fail "no sentinel skip line — the NaN burst was not absorbed"
+grep -q "quarantined corrupt checkpoint" "$P1/drill.log" \
+  || fail "no quarantine line — the torn checkpoint was not caught"
+ls "$P1"/ckpt_e*.msgpack.corrupt >/dev/null 2>&1 \
+  || fail "no *.corrupt file left behind by the quarantine"
+[ -s "$P1/restarts.log" ] || fail "restarts.log missing or empty"
+grep -q "action=restart" "$P1/restarts.log" \
+  || fail "restarts.log has no restart events"
+[ -f "$P1/ckpt_e2.msgpack" ] || fail "final epoch checkpoint missing"
+echo "[drill] phase 1 OK: converged to rc 0 through" \
+     "$(grep -c 'action=restart' "$P1/restarts.log") restarts"
+
+# ---------------------------------------------------------------- phase 2 --
+P2="$OUT/diverge"
+rm -rf "$P2"; mkdir -p "$P2"
+SPEC2="nan_loss@step=2.."
+echo "[drill] phase 2: $SPEC2 (sustained NaN, max_bad_steps=4)"
+MAX_RESTARTS=5 RUNTIME_BACKOFF_S=1 \
+  bash "$REPO/scripts/supervise.sh" "${COMMON[@]}" \
+    --out "$P2" --fault_spec "$SPEC2" --max_bad_steps 4 \
+    2>&1 | tee "$P2/drill.log"
+rc=${PIPESTATUS[0]}
+
+[ "$rc" -eq 8 ] || fail "phase 2 exited rc=$rc, want 8 (see $P2/drill.log)"
+grep -q "diverged" "$P2/drill.log" || fail "no divergence diagnostic"
+grep -q "action=restart" "$P2/restarts.log" 2>/dev/null \
+  && fail "rc 8 was restarted — deterministic divergence must stop the chain"
+grep -q "rc=8" "$P2/restarts.log" || fail "rc=8 stop not logged"
+echo "[drill] phase 2 OK: sustained NaN stopped at rc 8 without a restart"
+
+echo "CHAOS DRILL PASS"

@@ -6,10 +6,31 @@
 # restart command is identical to the start command because auto-resume picks
 # up the latest checkpoint in --out.
 #
+# Every non-zero exit is appended to $OUT/restarts.log (timestamp, rc,
+# backoff, attempt, action) when an --out dir is present in the args — the
+# post-mortem record of what the recovery chain actually did.
+#
 # Usage: MAX_RESTARTS=5 bash scripts/supervise.sh <workload> --out runs/x [flags...]
 set -u
 max=${MAX_RESTARTS:-5}
 n=0
+
+# find the --out value so restart events can be logged next to the run's
+# checkpoints/records; no --out, no log (nowhere durable to put it)
+out=""
+prev=""
+for a in "$@"; do
+  [ "$prev" = "--out" ] && out="$a"
+  prev="$a"
+done
+
+log_event() { # $1=rc $2=backoff $3=action
+  [ -n "$out" ] || return 0
+  mkdir -p "$out" 2>/dev/null || return 0
+  echo "$(date -Is) rc=$1 backoff=${2}s attempt=$n/$max action=$3" \
+    >> "$out/restarts.log"
+}
+
 while true; do
   python -m ddp_classification_pytorch_tpu.cli.train "$@" --auto_resume
   rc=$?
@@ -17,7 +38,10 @@ while true; do
   # rc classification lives HERE, one level below any window scheduler:
   # 2 is deterministic (config/usage — the trainer maps its own config
   # validation to SystemExit(2), same code argparse uses) — restarting
-  # replays the same failure; bare 1 is an UNHANDLED runtime exception
+  # replays the same failure; 8 is deterministic too (the non-finite step
+  # sentinel: training diverged, every restart resumes the same weights
+  # into the same divergence) — a hot-loop restart would burn the whole
+  # retry budget replaying it; bare 1 is an UNHANDLED runtime exception
   # (transient XlaRuntimeError via the tunnel, in-process OOM, dataloader
   # IO) — retryable, but with a backoff so a crash loop doesn't spin;
   # 3 is "backend unreachable" (trainer and bench share the code), where
@@ -29,6 +53,13 @@ while true; do
     2)
       echo "[supervise] rc=$rc is deterministic (config/usage error);" \
            "not restarting" >&2
+      log_event "$rc" 0 stop
+      exit "$rc" ;;
+    8)
+      echo "[supervise] rc=$rc is deterministic (training diverged:" \
+           "sentinel hit max_bad_steps consecutive non-finite steps);" \
+           "not restarting" >&2
+      log_event "$rc" 0 stop
       exit "$rc" ;;
     1) backoff=${RUNTIME_BACKOFF_S:-30} ;;
     3) backoff=${OUTAGE_BACKOFF_S:-300} ;;
@@ -37,9 +68,11 @@ while true; do
   n=$((n + 1))
   if [ "$n" -gt "$max" ]; then
     echo "[supervise] giving up after $n failures (last rc=$rc)" >&2
+    log_event "$rc" "$backoff" give-up
     exit "$rc"
   fi
   echo "[supervise] trainer exited rc=$rc; restart $n/$max (auto-resume," \
        "${backoff}s backoff)" >&2
+  log_event "$rc" "$backoff" restart
   sleep "$backoff"
 done

@@ -74,6 +74,43 @@ def test_async_write_failure_surfaces(tmp_path):
     os.makedirs(tmp_path, exist_ok=True)
 
 
+def test_async_failure_surfaces_on_next_save_and_then_clears(tmp_path):
+    """wait() re-raises an async write failure exactly once — including the
+    implicit wait() at the head of the NEXT save — and a later wait() must
+    not re-raise a failure that was already surfaced."""
+    import shutil
+
+    import pytest as _pytest
+
+    mgr = CheckpointManager(str(tmp_path), async_save=True)
+    mgr.save(_state(0.0), 0)
+    mgr.wait()
+    shutil.rmtree(tmp_path)  # make the next write fail
+    mgr.save(_state(1.0), 1)
+    mgr._pending.join()  # let the failure land without consuming it
+    import os
+
+    os.makedirs(tmp_path, exist_ok=True)
+    with _pytest.raises(RuntimeError, match="async checkpoint write failed"):
+        mgr.save(_state(2.0), 2)  # the one-in-flight wait() surfaces it
+    # surfaced once: the slot is clear, the next save/wait succeed
+    mgr.save(_state(3.0), 3)
+    mgr.wait()
+    assert 3 in mgr._epoch_checkpoints()
+
+
+def test_read_meta_at_tolerates_any_torn_content(tmp_path):
+    """read_meta_at must absorb every torn-file shape — truncated JSON,
+    binary garbage (UnicodeDecodeError, not JSONDecodeError), and an empty
+    file — or a single bad meta.json crashes every restart identically."""
+    meta = tmp_path / "meta.json"
+    for content in (b'{"last_epoch": 3, "best_', b"\x80\x81\xfe\xff\x00",
+                    b""):
+        meta.write_bytes(content)
+        assert CheckpointManager.read_meta_at(str(meta)) == {}, content
+    assert CheckpointManager.read_meta_at(str(tmp_path / "absent.json")) == {}
+
+
 def test_meta_lands_after_bytes(tmp_path):
     # meta.json must not claim an epoch whose checkpoint has not hit disk;
     # easiest observable: after wait(), both exist and agree

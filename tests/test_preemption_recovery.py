@@ -51,22 +51,40 @@ def _write_imagefolder(root, classes=2, per_train=64, per_val=16, size=32):
 
 
 def _cmd(folder, out, epochs):
+    # --dtype float32: at lr 0.05 this 2-class toy recipe collapses to
+    # CE-loss-exactly-0 with saturated logits, and under bf16 that regime
+    # sits on a knife edge where the ULP-level difference between a
+    # persistent-cache-DESERIALIZED executable (the resumed process) and
+    # the freshly compiled one (the producer) amplifies into NaN within
+    # one step — the resumed run then legitimately exits rc 8 via the
+    # step sentinel. f32 headroom keeps the replayed trajectory inside
+    # the comparison tolerance; the chain under test (kill → supervise →
+    # auto-resume → continue) is dtype-independent.
     return [
         sys.executable, "-m", "ddp_classification_pytorch_tpu.cli.train", "plc",
         "--folder", str(folder), "--transform", "cifar", "--image_size", "32",
         "--variant", "cifar", "--model", "resnet18", "--num_classes", "2",
         "--batchsize", "16", "--num_workers", "2", "--lr", "0.05",
+        "--dtype", "float32",
         "--epochs", str(epochs), "--correction", "lrt",
         "--plc_warmup_epochs", "0", "--out", str(out), "--seed", "123",
         "--platform", "cpu", "--auto_resume",
     ]
 
 
-def _env():
+def _env(cache_dir):
     env = dict(os.environ)
     # single virtual device keeps the subprocess light; determinism does not
     # depend on the device count (it is keyed per (seed, epoch, index))
     env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=1"
+    # a FRESH compilation-cache dir per invocation: a persistent-cache
+    # DESERIALIZED executable differs from the in-memory compiled one at
+    # the ULP level (observed live: the resumed process loaded the cache
+    # entry its producer wrote, drifted one ULP, and this recipe's
+    # saturated-logits regime amplified that into NaN within one step).
+    # The replay-equality assertion below requires bit-identical
+    # executables, so every subprocess compiles fresh.
+    env["JAX_COMPILATION_CACHE_DIR"] = str(cache_dir)
     return env
 
 
@@ -91,7 +109,8 @@ def test_kill_mid_epoch_then_supervise_resume_matches_uninterrupted(tmp_path):
     out_b = tmp_path / "preempted"
 
     # Control: one clean run to completion.
-    r = subprocess.run(_cmd(data, out_a, epochs), env=_env(), cwd=REPO,
+    r = subprocess.run(_cmd(data, out_a, epochs),
+                       env=_env(tmp_path / "xla_cache_control"), cwd=REPO,
                        capture_output=True, text=True, timeout=600)
     assert r.returncode == 0, r.stderr[-2000:]
     rows_a = _epoch_rows(out_a)
@@ -101,7 +120,8 @@ def test_kill_mid_epoch_then_supervise_resume_matches_uninterrupted(tmp_path):
     # kill with later epochs still outstanding, like a real preemption.
     # No grace sleep: on a fast host a fixed sleep could let the remaining
     # epochs finish and make the kill vacuous.
-    proc = subprocess.Popen(_cmd(data, out_b, epochs), env=_env(), cwd=REPO,
+    proc = subprocess.Popen(_cmd(data, out_b, epochs),
+                            env=_env(tmp_path / "xla_cache_preempted"), cwd=REPO,
                             stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
     marker = out_b / "ckpt_e1.msgpack"
     deadline = time.time() + 420
@@ -124,7 +144,7 @@ def test_kill_mid_epoch_then_supervise_resume_matches_uninterrupted(tmp_path):
     r2 = subprocess.run(
         ["bash", os.path.join(REPO, "scripts", "supervise.sh")]
         + _cmd(data, out_b, epochs)[3:],  # supervise prepends `python -m <module>`
-        env={**_env(), "MAX_RESTARTS": "2"},
+        env={**_env(tmp_path / "xla_cache_resume"), "MAX_RESTARTS": "2"},
         cwd=REPO, capture_output=True, text=True, timeout=900)
     assert r2.returncode == 0, (r2.stdout[-1000:], r2.stderr[-2000:])
     assert "auto-resumed" in r2.stdout
