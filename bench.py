@@ -29,7 +29,11 @@ the real `ShardedLoader → DevicePrefetcher → train step` pipeline against
 a generated on-disk image folder (synthetic on CPU), so host assembly +
 H2D overlap — the stage the device-only rows exclude by design and
 bench_input.py (host-only) cannot see — is a measured, regression-guarded
-number (docs/performance.md "H2D overlap and the e2e benchmark").
+number (docs/performance.md "H2D overlap and the e2e benchmark"). The row
+carries `h2d_bytes_per_step` + `input_dtype` evidence of the wire format
+(`--input-dtype`, default uint8: raw pixels at ¼ the float32 bytes,
+normalization fused into the jitted step — docs/performance.md "Wire
+format: uint8 H2D").
 
 Usage: python bench.py [--batch N] [--steps N] [--arch resnet50]
                        [--deadline SECONDS] [--rows arcface,vit] [--e2e]
@@ -344,6 +348,9 @@ def _bench_e2e_row(cfg, mesh, *, steps: int, warmup: int, metric: str,
     from ddp_classification_pytorch_tpu.train.state import create_train_state
     from ddp_classification_pytorch_tpu.train.steps import make_train_step
 
+    import numpy as np
+    from ddp_classification_pytorch_tpu.parallel import mesh as meshlib
+
     batcher = None
     if dataset_kind == "imagefolder":
         from bench_input import ensure_dataset
@@ -352,7 +359,8 @@ def _bench_e2e_row(cfg, mesh, *, steps: int, warmup: int, metric: str,
 
         ensure_dataset(root, n_images, src_size)
         tf = build_transform("baseline", train=True,
-                             image_size=cfg.data.image_size)
+                             image_size=cfg.data.image_size,
+                             out_dtype=cfg.data.input_dtype)
         ds = ImageFolderDataset.from_root(root, tf)
         batcher = make_native_batcher(ds, cfg, train=True)
         input_path = "native" if batcher is not None else "python"
@@ -360,14 +368,30 @@ def _bench_e2e_row(cfg, mesh, *, steps: int, warmup: int, metric: str,
         from ddp_classification_pytorch_tpu.data import SyntheticDataset
 
         ds = SyntheticDataset(n_images, cfg.data.image_size,
-                              cfg.data.num_classes)
+                              cfg.data.num_classes,
+                              out_dtype=cfg.data.input_dtype)
         input_path = "synthetic"
 
     batch = cfg.data.batch_size
     loader = ShardedLoader(ds, batch, shuffle=True, seed=cfg.run.seed,
                            num_workers=num_workers,
                            prefetch=cfg.data.prefetch, batcher=batcher)
-    prefetcher = DevicePrefetcher(loader, mesh, depth=device_prefetch)
+    # wire-format evidence, captured from the REAL first host batch (not
+    # recomputed from config): per-step H2D payload bytes and the dtype
+    # that actually crossed — the uint8 dataplane's ~4× cut shows up here
+    wire: dict = {}
+    sharding = meshlib.batch_sharding(mesh)
+
+    def assemble(batch_idx, host_batch):
+        if not wire:
+            images, labels = host_batch
+            wire["h2d_bytes_per_step"] = int(
+                np.asarray(images).nbytes + np.asarray(labels).nbytes)
+            wire["input_dtype"] = str(np.asarray(images).dtype)
+        return meshlib.make_global_array(host_batch, mesh, sharding=sharding)
+
+    prefetcher = DevicePrefetcher(loader, mesh, depth=device_prefetch,
+                                  assemble=assemble)
     main_ident = __import__("threading").get_ident()
 
     def batches():
@@ -407,6 +431,10 @@ def _bench_e2e_row(cfg, mesh, *, steps: int, warmup: int, metric: str,
         "device_prefetch": device_prefetch,
         "input": input_path,
         "host_workers": num_workers,
+        # wire-format evidence (uint8 dataplane): observed per-step H2D
+        # payload bytes + the dtype that actually crossed the wire
+        "h2d_bytes_per_step": wire.get("h2d_bytes_per_step", 0),
+        "input_dtype": wire.get("input_dtype", cfg.data.input_dtype),
         # evidence the overlap actually ran: how many batches the stager
         # assembled, and whether assembly happened off the consumer thread
         "staged_batches": prefetcher.staged,
@@ -487,6 +515,13 @@ def main() -> None:
                     help="host loader threads for --e2e; 0 = cpu count")
     ap.add_argument("--device-prefetch", type=int, default=2,
                     help="DevicePrefetcher depth for --e2e (0 = synchronous)")
+    ap.add_argument("--input-dtype", default="uint8",
+                    choices=["uint8", "float32"],
+                    help="H2D wire format for --e2e (data.input_dtype): "
+                         "uint8 ships raw pixels at ¼ the bytes with "
+                         "on-device normalization; float32 is the legacy "
+                         "host-normalize wire. The row's h2d_bytes_per_step "
+                         "/ input_dtype fields record what actually crossed")
     args = ap.parse_args()
 
     def remaining() -> float:
@@ -673,6 +708,7 @@ def main() -> None:
             try:
                 kind = args.e2e_dataset or (
                     "imagefolder" if on_accel else "synthetic")
+                cfg.data.input_dtype = args.input_dtype
                 row = _bench_e2e_row(
                     cfg, mesh, steps=steps, warmup=max(warmup // 2, 1),
                     metric=_e2e_metric_name(args.arch, on_accel, platform),
@@ -684,7 +720,9 @@ def main() -> None:
                 extra.append(row)
                 partial_box["row"] = dict(partial_box["row"], extra=list(extra))
                 print(f"# e2e row ({row['input']}, prefetch "
-                      f"{row['device_prefetch']}): {row['value']} img/s/chip, "
+                      f"{row['device_prefetch']}, wire {row['input_dtype']} "
+                      f"{row['h2d_bytes_per_step']} B/step): "
+                      f"{row['value']} img/s/chip, "
                       f"step {row['step_ms']}ms, staged "
                       f"{row['staged_batches']} off-thread="
                       f"{row['staged_off_thread']}", file=sys.stderr)

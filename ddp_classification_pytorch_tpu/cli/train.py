@@ -65,6 +65,12 @@ def build_parser() -> argparse.ArgumentParser:
                         "cdr | cifar | clothing1m (default: workload preset; "
                         "'cifar' = pad-4 random crop + flip at --image_size, "
                         "for small-image folders)")
+    d.add_argument("--input_dtype", default="", choices=["", "uint8", "float32"],
+                   help="H2D wire format (default uint8): 'uint8' ships raw "
+                        "pixels at ¼ the bytes and fuses normalization + the "
+                        "train flip into the jitted step; 'float32' is the "
+                        "legacy host-normalize path, numerically exact to "
+                        "the pre-uint8 framework")
 
     m = p.add_argument_group("model")
     m.add_argument("--model", "--arch", dest="model", default="",
@@ -281,6 +287,8 @@ def config_from_args(args: argparse.Namespace) -> Config:
         cfg.data.train_crop_size = args.crop_size
     if args.transform:
         cfg.data.transform = args.transform
+    if args.input_dtype:
+        cfg.data.input_dtype = args.input_dtype
 
     if args.model:
         cfg.model.arch = args.model

@@ -44,6 +44,16 @@ class DataConfig:
     # 0 = synchronous assembly inside the step loop (the pre-prefetch path).
     device_prefetch: int = 2
     synthetic_size: int = 0  # for dataset == "synthetic"
+    # H2D wire format (data/transforms.py, train/steps.py). "uint8"
+    # (default): transforms emit raw uint8 HWC pixels — ¼ the host→device
+    # bytes of normalized float32 — and the jitted step normalizes
+    # `(x/255−μ)/σ` (plus the train-time horizontal flip, rng threaded from
+    # the step key) as a device-side epilogue XLA fuses into the first
+    # conv's input read. "float32": the legacy host-normalize path,
+    # numerically exact to the pre-uint8 framework — the fallback when
+    # bitwise reproduction of an old run matters. The two match to float
+    # tolerance on identical crops (quantization is pre-normalize in both).
+    input_dtype: str = "uint8"
     # transform preset: baseline | cdr | cifar | clothing1m (SURVEY C15)
     transform: str = "baseline"
     # use the native C++ dataplane (libjpeg decode + fused transform) for

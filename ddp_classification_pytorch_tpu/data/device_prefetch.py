@@ -21,6 +21,12 @@ re-raised at the iteration site, `None` sentinel for end-of-iteration.
 Memory cost: each staged batch holds device memory, so depth N keeps up to
 N extra batches (plus one in the stager's hand) resident in HBM. Depth 0
 degrades to the exact synchronous path — same calls, same order, inline.
+
+Wire format: staging is dtype-transparent — `make_global_array` preserves
+the host batch's dtype, so the uint8 dataplane (data.input_dtype) ships
+uint8 global arrays end-to-end and each staged H2D copy moves ¼ the bytes
+of the float32 wire (the two levers compose: fewer bytes per transfer AND
+the transfer overlapped with compute).
 """
 
 from __future__ import annotations

@@ -73,7 +73,11 @@ class ShardedLoader:
     """Iterates (images, labels) numpy batches for this host.
 
     dataset must support `__len__` and `__getitem__(i, rng)` →
-    (HWC float32, int label).
+    (HWC image, int label). The image dtype IS the H2D wire format and is
+    preserved verbatim through batching (`np.stack`): uint8 datasets
+    (data.input_dtype == "uint8", the default — ¼ the transfer bytes) yield
+    uint8 batches the jitted step normalizes on device; float32 datasets
+    yield the legacy pre-normalized wire.
     """
 
     def __init__(

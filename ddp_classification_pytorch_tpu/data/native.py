@@ -93,6 +93,10 @@ def get_lib() -> Optional[ctypes.CDLL]:
 
 _MEAN = (ctypes.c_float * 3)(*IMAGENET_MEAN)
 _STD = (ctypes.c_float * 3)(*IMAGENET_STD)
+# identity "normalization" for the uint8 wire: (v/255 − 0)/(1/255) = v, so
+# the C side hands back raw 0..255 pixel values (float, pre-quantization)
+_MEAN_RAW = (ctypes.c_float * 3)(0.0, 0.0, 0.0)
+_STD_RAW = (ctypes.c_float * 3)(1.0 / 255.0, 1.0 / 255.0, 1.0 / 255.0)
 
 
 def native_decodes_png() -> bool:
@@ -111,8 +115,13 @@ def native_load_batch(
     scale: Tuple[float, float] = (0.8, 1.0),
     seed: int = 0,
     num_threads: int = 4,
+    raw: bool = False,
 ) -> Optional[Tuple[np.ndarray, int]]:
     """Decode+transform a list of JPEG/PNG paths into (B, S, S, 3) f32.
+
+    `raw` swaps the ImageNet constants for the identity pair, so the C side
+    returns un-normalized 0..255 pixel values (still float — the caller
+    quantizes; the uint8-wire path in NativeBatcher).
 
     Returns (batch, n_failures) or None when the native library is
     unavailable. Failure slots are zero-filled; the caller patches them via
@@ -128,7 +137,7 @@ def native_load_batch(
         arr, n, out.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
         out_size, out_size, int(train), resize_short,
         float(scale[0]), float(scale[1]), ctypes.c_uint64(seed),
-        _MEAN, _STD, num_threads,
+        _MEAN_RAW if raw else _MEAN, _STD_RAW if raw else _STD, num_threads,
     )
     return out, int(errors)
 
@@ -145,7 +154,8 @@ class NativeBatcher:
     SUPPORTED = ("baseline", "clothing1m")
 
     def __init__(self, dataset, preset: str, train: bool,
-                 image_size: int, crop_size: int, seed: int, num_threads: int = 4):
+                 image_size: int, crop_size: int, seed: int, num_threads: int = 4,
+                 out_dtype: str = "float32"):
         from .transforms import build_transform
 
         self.dataset = dataset
@@ -153,8 +163,17 @@ class NativeBatcher:
         self.seed = seed
         self.num_threads = num_threads
         self.resize_short = crop_size
-        # mirror build_transform's output-size quirk (train@crop_size for baseline)
-        t = build_transform(preset, train, image_size, crop_size)
+        # uint8 wire: the C call runs with identity mean/std (raw 0..255
+        # floats) and the batch is quantized to uint8 here; the jitted step
+        # normalizes on device. The native train flip stays on (the C
+        # signature ties it to `train`), so with the device epilogue's flip
+        # the sample is flipped twice with independent draws — the composed
+        # distribution is still flip-with-prob-0.5, augmentation-equivalent.
+        self.out_dtype = out_dtype
+        # mirror build_transform's output-size quirk (train@crop_size for
+        # baseline) AND its out_dtype validation
+        t = build_transform(preset, train, image_size, crop_size,
+                            out_dtype=out_dtype)
         self.out_size = t.out_size
         self.scale = (0.08, 1.0) if preset == "clothing1m" else (0.8, 1.0)
 
@@ -167,15 +186,22 @@ class NativeBatcher:
         labels = np.asarray(
             [self.dataset.labels[int(i)] for i in indices], np.int32)
         seed = (self.seed * 1_000_003 + epoch * 10_007 + batch_idx) & 0xFFFFFFFF
+        emit_uint8 = self.out_dtype == "uint8"
         res = native_load_batch(
             paths, self.out_size, self.train, self.resize_short,
-            self.scale, seed, self.num_threads)
+            self.scale, seed, self.num_threads, raw=emit_uint8)
         if res is None:
             raise RuntimeError("native dataplane unavailable")
         images, errors = res
+        if emit_uint8:
+            # quantize the C side's float resample output (PIL quantizes at
+            # the same point; ±0.5/255 vs the native-float path — within the
+            # documented "up to resampling details" envelope)
+            images = np.clip(np.rint(images), 0, 255).astype(np.uint8)
         if errors:
             rng = np.random.default_rng(seed)
-            for j in np.nonzero(np.abs(images).sum(axis=(1, 2, 3)) == 0)[0]:
+            for j in np.nonzero(
+                    np.abs(images.astype(np.float32)).sum(axis=(1, 2, 3)) == 0)[0]:
                 img, _ = self.dataset.__getitem__(int(indices[j]), rng)
                 images[j] = img
         return images, labels

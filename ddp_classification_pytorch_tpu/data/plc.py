@@ -93,7 +93,9 @@ class PLCDataset:
 
     def __getitem__(self, i: int, rng: Optional[np.random.Generator] = None):
         """→ (image, label, index) — index lets correction loops address
-        samples (FolderDataset.py:56-75)."""
+        samples (FolderDataset.py:56-75). The image dtype follows the
+        transform's wire format (uint8 HWC on the default uint8 dataplane,
+        normalized float32 on the legacy wire)."""
         rng = rng or np.random.default_rng()
         with Image.open(os.path.join(self.data_root, self.keys[i])) as img:
             arr = self.transform(img, rng)

@@ -23,6 +23,13 @@ class SyntheticDataset:
     # offsets the per-item noise stream so train/val share class means (the
     # learnable mapping) but draw disjoint samples
     item_offset: int = 0
+    # "float32" (legacy): raw N(class_mean, 0.1) floats. "uint8": the same
+    # per-item floats affinely mapped into [0, 255] and quantized — the real
+    # H2D wire format (data.input_dtype), so e2e benchmarks and trainer
+    # tests exercise the uint8 path + on-device normalization end-to-end.
+    # Class separation survives the mapping (~1.0 float between means →
+    # ~64 uint8 levels vs ~6 levels of noise), so the task stays learnable.
+    out_dtype: str = "float32"
 
     def __post_init__(self) -> None:
         # class means on a stream keyed by seed ONLY, so train/val datasets of
@@ -50,4 +57,8 @@ class SyntheticDataset:
         img = self.class_means[label] + 0.1 * item_rng.normal(
             size=(self.image_size, self.image_size, self.channels)
         ).astype(np.float32)
+        if self.out_dtype == "uint8":
+            # ~N(0,1) class means land mostly inside [-2, 2] → [0, 255]
+            return np.clip(np.rint((img * 0.25 + 0.5) * 255.0),
+                           0, 255).astype(np.uint8), label
         return img.astype(np.float32), label

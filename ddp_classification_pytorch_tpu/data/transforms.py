@@ -10,21 +10,45 @@ Presets:
 - cifar train: RandomCrop(32, padding=4) + flip (NESTED/train.py:40-44).
 - clothing1m train: RandomResizedCrop(224) + flip (NESTED/train.py:55-59).
 
-All emit float32 NHWC normalized with the ImageNet mean/std the reference
-hardcodes everywhere. TPU note: outputs are channel-last (NHWC), XLA:TPU's
-native conv layout; the reference's NCHW is a torch convention, not copied.
+Output wire format (`out_dtype`):
+- "float32" (legacy): normalized float32 NHWC with the ImageNet mean/std the
+  reference hardcodes everywhere — every batch crosses host→device at 4× the
+  bytes of its pixels.
+- "uint8": the geometric ops (crop/resize/rotation) still run host-side on
+  PIL, but the final tensor is raw uint8 HWC; normalization `(x/255−μ)/σ`
+  and the train-time horizontal flip move into the jitted step
+  (train/steps.py::device_input_epilogue), where XLA fuses them into the
+  first conv's input read. Quantization happens pre-normalize in BOTH modes
+  (PIL resampling yields uint8 before normalize runs), so the two paths
+  match to float tolerance on identical crops.
+
+TPU note: outputs are channel-last (NHWC), XLA:TPU's native conv layout; the
+reference's NCHW is a torch convention, not copied.
 """
 
 from __future__ import annotations
 
 import dataclasses
-from typing import Tuple
+from typing import Optional, Tuple
 
 import numpy as np
 from PIL import Image
 
 IMAGENET_MEAN = np.array([0.485, 0.456, 0.406], np.float32)
 IMAGENET_STD = np.array([0.229, 0.224, 0.225], np.float32)
+
+INPUT_DTYPES = ("uint8", "float32")
+
+
+def preset_for_dataset(dataset: str, transform: str) -> Optional[str]:
+    """Transform-preset name the data pipeline uses for a DataConfig's
+    dataset kind, or None when the kind has no image transform (synthetic).
+    Single source of truth shared by `train/loop.py::build_datasets`, the
+    PLC eval-view prediction pipeline, and the train step's device-flip
+    gate (a preset implies the train pipeline includes a horizontal flip,
+    which the uint8 wire moves on-device)."""
+    return {"imagefolder": transform, "plc": "clothing1m",
+            "cifar10": "cifar", "cifar100": "cifar"}.get(dataset)
 
 
 def normalize(img: np.ndarray) -> np.ndarray:
@@ -77,24 +101,34 @@ def random_crop_padded(img: np.ndarray, rng: np.random.Generator, size: int, pad
 
 @dataclasses.dataclass
 class Transform:
-    """A picklable (fn ships to worker processes) train/eval transform."""
+    """A picklable (fn ships to worker processes) train/eval transform.
+
+    out_dtype "uint8" emits the raw post-geometry uint8 HWC pixels (the 4×-
+    smaller H2D wire format); normalization AND the train flip then run
+    on-device inside the jitted step. The geometric rng draws (crop box,
+    rotation) are identical in both modes — only the final flip draw is
+    skipped, so the two modes see the same crops."""
 
     kind: str
     train: bool
     crop_size: int
     out_size: int
+    out_dtype: str = "float32"
 
     def __call__(self, img: Image.Image, rng: np.random.Generator) -> np.ndarray:
+        emit_uint8 = self.out_dtype == "uint8"
+        # host flip only on the float wire; the uint8 wire flips in-jit
+        # (train/steps.py::device_input_epilogue, rng from the step key)
+        host_flip = self.train and not emit_uint8
         if img.mode != "RGB":
             img = img.convert("RGB")
         if self.kind == "cifar":
             arr = np.asarray(img, np.uint8)
             if self.train:
                 arr = random_crop_padded(arr, rng, self.out_size, 4)
-                if rng.uniform() < 0.5:
+                if host_flip and rng.uniform() < 0.5:
                     arr = arr[:, ::-1]
-            return normalize(np.ascontiguousarray(arr))
-        if self.train:
+        elif self.train:
             if self.kind == "cdr":
                 # CDR/main.py:113-119: rotation ±15°, flip, resize 256, center 224
                 img = img.rotate(float(rng.uniform(-15, 15)), Image.BILINEAR)
@@ -104,25 +138,32 @@ class Transform:
             else:  # baseline (BASELINE/main.py:60-63): RRC(crop) scale .8-1
                 img = random_resized_crop(img, rng, self.out_size, scale=(0.8, 1.0))
             arr = np.asarray(img, np.uint8)
-            if rng.uniform() < 0.5:
+            if host_flip and rng.uniform() < 0.5:
                 arr = arr[:, ::-1]
         else:
             img = resize_center_crop(img, self.crop_size, self.out_size)
             arr = np.asarray(img, np.uint8)
-        return normalize(np.ascontiguousarray(arr))
+        arr = np.ascontiguousarray(arr)
+        return arr if emit_uint8 else normalize(arr)
 
 
 TRANSFORM_PRESETS = ("baseline", "cdr", "cifar", "clothing1m")
 
 
 def build_transform(preset: str, train: bool, image_size: int = 224,
-                    crop_size: int = 256) -> Transform:
+                    crop_size: int = 256,
+                    out_dtype: str = "float32") -> Transform:
     if preset not in TRANSFORM_PRESETS:
         raise ValueError(f"unknown transform preset {preset!r}")
+    if out_dtype not in INPUT_DTYPES:
+        raise ValueError(
+            f"unknown input dtype {out_dtype!r}; one of {INPUT_DTYPES}")
     if preset == "cifar":
-        return Transform(preset, train, crop_size=image_size, out_size=image_size)
+        return Transform(preset, train, crop_size=image_size,
+                         out_size=image_size, out_dtype=out_dtype)
     # NOTE the reference trains at RandomResizedCrop(256) but evals at
     # CenterCrop(224) (BASELINE/main.py:61,73-74) — an asymmetric quirk we
     # reproduce: train output size = crop_size for baseline, image_size others.
     out = crop_size if (train and preset == "baseline") else image_size
-    return Transform(preset, train, crop_size=crop_size, out_size=out)
+    return Transform(preset, train, crop_size=crop_size, out_size=out,
+                     out_dtype=out_dtype)

@@ -13,6 +13,10 @@ reference's observable behavior:
     checkpoint (per-epoch and/or best-only; host-0 writes)
 
 TPU-first details the reference has no analogue for:
+- batches cross host→device as raw uint8 pixels by default
+  (`data.input_dtype` — ¼ the H2D bytes of normalized float32), with
+  normalization + the train flip fused into the jitted step's input read
+  (train/steps.py::device_input_epilogue);
 - batches go host→device through `make_global_array` (per-host shard of a
   global batch-sharded jax.Array) on a background stager thread
   (`data/device_prefetch.py`) that keeps `data.device_prefetch` device
@@ -56,22 +60,26 @@ from .steps import make_eval_step, make_nested_eval_step, make_train_step
 
 def dataset_transform_preset(d) -> Optional[str]:
     """Transform-preset name `build_datasets` uses for this DataConfig, or
-    None when the dataset kind has no image transform (synthetic). The single
-    source of truth for callers that rebuild a transform for an existing
-    dataset (e.g. the PLC eval-view prediction pipeline)."""
-    return {"imagefolder": d.transform, "plc": "clothing1m",
-            "cifar10": "cifar", "cifar100": "cifar"}.get(d.dataset)
+    None when the dataset kind has no image transform (synthetic). Delegates
+    to `data.transforms.preset_for_dataset`, the single source of truth it
+    shares with the train step's device-flip gate."""
+    from ..data.transforms import preset_for_dataset
+
+    return preset_for_dataset(d.dataset, d.transform)
 
 
 def make_native_batcher(ds, cfg: Config, train: bool) -> Optional[NativeBatcher]:
     """NativeBatcher for `ds` iff the C++ dataplane applies to this config
-    (same eligibility the Trainer uses), else None."""
+    (same eligibility the Trainer uses), else None. Honors the wire format:
+    with `data.input_dtype == "uint8"` the batcher emits quantized uint8
+    pixels (train flip deferred to the device epilogue)."""
     d = cfg.data
     if (d.native_loader and d.dataset == "imagefolder"
             and d.transform in NativeBatcher.SUPPORTED
             and hasattr(ds, "paths") and NativeBatcher.available()):
         return NativeBatcher(ds, d.transform, train, d.image_size,
-                             d.train_crop_size, cfg.run.seed, d.num_workers)
+                             d.train_crop_size, cfg.run.seed, d.num_workers,
+                             out_dtype=d.input_dtype)
     return None
 
 
@@ -79,19 +87,29 @@ def build_datasets(cfg: Config) -> Tuple[Any, Any]:
     """(train_ds, val_ds) from DataConfig — the reference's per-silo dataset
     blocks (BASELINE/main.py:124-125, CDR/main.py:296, NESTED/train.py:342)."""
     d = cfg.data
+    from ..data.transforms import INPUT_DTYPES
+
+    if d.input_dtype not in INPUT_DTYPES:
+        # construction-time ValueError → the CLI maps it to rc 2
+        raise ValueError(
+            f"unknown data.input_dtype {d.input_dtype!r}; one of {INPUT_DTYPES}")
     if d.dataset == "synthetic":
         size = d.synthetic_size or 512
-        train = SyntheticDataset(size, d.image_size, d.num_classes, seed=cfg.run.seed)
+        train = SyntheticDataset(size, d.image_size, d.num_classes,
+                                 seed=cfg.run.seed, out_dtype=d.input_dtype)
         val = SyntheticDataset(max(size // 4, d.batch_size), d.image_size,
-                               d.num_classes, seed=cfg.run.seed, item_offset=size)
+                               d.num_classes, seed=cfg.run.seed,
+                               item_offset=size, out_dtype=d.input_dtype)
         return train, val
     preset = dataset_transform_preset(d)
     if preset is None:
         raise ValueError(f"unknown dataset {d.dataset!r}")
     t_train = build_transform(preset, train=True, image_size=d.image_size,
-                              crop_size=d.train_crop_size)
+                              crop_size=d.train_crop_size,
+                              out_dtype=d.input_dtype)
     t_val = build_transform(preset, train=False, image_size=d.image_size,
-                            crop_size=d.train_crop_size)
+                            crop_size=d.train_crop_size,
+                            out_dtype=d.input_dtype)
     if d.dataset == "imagefolder":
         train = ImageFolderDataset.from_root(
             d.train_dir, t_train, d.imgs_per_class, d.max_classes)

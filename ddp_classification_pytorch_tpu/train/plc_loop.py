@@ -123,9 +123,12 @@ class PLCTrainer(Trainer):
             # rebinds rather than mutates) — the predict loader discards
             # labels, so nothing may consume them from this view
             ds = copy.copy(ds)
+            # same wire format as training: uint8 stays uint8 end-to-end
+            # (the jitted predict step normalizes on device)
             ds.transform = build_transform(preset, train=False,
                                            image_size=d.image_size,
-                                           crop_size=d.train_crop_size)
+                                           crop_size=d.train_crop_size,
+                                           out_dtype=d.input_dtype)
         batcher = make_native_batcher(ds, self.cfg, train=False)
         self._predict_ds, self._predict_batcher = ds, batcher
         return ds, batcher

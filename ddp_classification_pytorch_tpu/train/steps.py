@@ -26,6 +26,11 @@ import jax.numpy as jnp
 import optax
 
 from ..config import Config
+from ..data.transforms import (
+    IMAGENET_MEAN,
+    IMAGENET_STD,
+    preset_for_dataset,
+)
 from ..models.factory import feat_dim_for
 from ..ops.nested import (
     gaussian_dist,
@@ -36,7 +41,50 @@ from ..ops.nested import (
 from ..utils.metrics import topk_correct, topk_hits
 from .state import TrainState
 
-Batch = Tuple[jnp.ndarray, jnp.ndarray]  # (images NHWC f32, labels i32)
+Batch = Tuple[jnp.ndarray, jnp.ndarray]  # (images NHWC u8|f32, labels i32)
+
+# fold_in tag deriving the flip stream from the step rng WITHOUT consuming
+# it — the float32 wire's mask/dropout derivations stay bit-identical
+_FLIP_FOLD = 0x464C4950  # "FLIP"
+
+
+def device_input_epilogue(images: jnp.ndarray,
+                          rng: Optional[jax.Array] = None,
+                          flip: bool = False) -> jnp.ndarray:
+    """uint8 wire → normalized float32 NHWC, in-jit.
+
+    The uint8 dataplane (data.input_dtype == "uint8") ships raw pixels
+    across H2D at ¼ the bytes and defers `(x/255 − μ)/σ` — same f32 op
+    order as the host `transforms.normalize`, so the two wires match to
+    float tolerance on identical crops — to this epilogue, which XLA fuses
+    into the first conv's input read (elementwise producer fusion: no extra
+    HBM pass). With `flip`, a per-sample horizontal flip (the train
+    augmentation the uint8 transforms skip host-side) draws its mask from
+    `fold_in(rng, _FLIP_FOLD)` — deterministic per step key, and fold_in
+    leaves the caller's rng stream untouched.
+
+    Dtype dispatch is static (jit specializes per input aval): float32
+    inputs pass through UNTOUCHED, so the legacy host-normalized path
+    compiles to exactly the pre-uint8 program."""
+    if images.dtype != jnp.uint8:
+        return images
+    x = images.astype(jnp.float32) / 255.0
+    x = (x - jnp.asarray(IMAGENET_MEAN)) / jnp.asarray(IMAGENET_STD)
+    if flip and rng is not None:
+        mask = jax.random.bernoulli(
+            jax.random.fold_in(rng, _FLIP_FOLD), 0.5, (images.shape[0],))
+        # NHWC: axis 2 is width — the host path's arr[:, ::-1] per sample
+        x = jnp.where(mask[:, None, None, None], x[:, :, ::-1, :], x)
+    return x
+
+
+def _train_flip_enabled(cfg: Config) -> bool:
+    """Device-side flip applies exactly where the float32 wire would have
+    host-flipped: train transforms of every image preset include one
+    (synthetic data has no transform → no flip)."""
+    return (cfg.data.input_dtype == "uint8"
+            and preset_for_dataset(cfg.data.dataset, cfg.data.transform)
+            is not None)
 
 
 def _cross_entropy(logits: jnp.ndarray, labels: jnp.ndarray) -> jnp.ndarray:
@@ -80,10 +128,13 @@ def make_train_step(
     if base_rng is None:
         base_rng = jax.random.PRNGKey(cfg.run.seed + 1)
 
+    flip = _train_flip_enabled(cfg)
+
     if cfg.parallel.arcface_sharded_ce and workload == "arcface":
         _require_sharded_ce_mesh(mesh)
         loss_fn, metrics_fn = _arcface_sharded_loss(cfg, model, mesh)
-        return _build_step(tx, base_rng, loss_fn, metrics_fn, chaos=chaos)
+        return _build_step(tx, base_rng, loss_fn, metrics_fn, chaos=chaos,
+                           flip=flip)
 
     if workload == "nested":
         dist = jnp.asarray(gaussian_dist(0.0, cfg.model.nested_std, feat_dim_for(cfg.model)))
@@ -112,7 +163,7 @@ def make_train_step(
 
     return _build_step(tx, base_rng, loss_fn,
                        lambda loss, logits, labels: _train_metrics(loss, logits, labels),
-                       chaos=chaos)
+                       chaos=chaos, flip=flip)
 
 
 def _require_sharded_ce_mesh(mesh) -> None:
@@ -129,7 +180,7 @@ def _require_sharded_ce_mesh(mesh) -> None:
             + ("no mesh" if mesh is None else f"mesh {dict(mesh.shape)}"))
 
 
-def _build_step(tx, base_rng, loss_fn, metrics_fn, chaos=None):
+def _build_step(tx, base_rng, loss_fn, metrics_fn, chaos=None, flip=False):
     """Shared optimizer-update skeleton for every train step: fold_in rng,
     value_and_grad over `loss_fn(params, stats, images, labels, rng) ->
     (loss, (new_stats, aux))`, apply updates, metrics via
@@ -152,6 +203,9 @@ def _build_step(tx, base_rng, loss_fn, metrics_fn, chaos=None):
 
     def step(state: TrainState, images: jnp.ndarray, labels: jnp.ndarray):
         rng = jax.random.fold_in(base_rng, state.step)
+        # uint8 wire → f32 (+ per-sample device flip); f32 wire untouched.
+        # Outside value_and_grad: images carry no parameter gradient.
+        images = device_input_epilogue(images, rng, flip=flip)
         (loss, (new_stats, aux)), grads = jax.value_and_grad(loss_fn, has_aux=True)(
             state.params, state.batch_stats, images, labels, rng
         )
@@ -240,6 +294,7 @@ def make_eval_step(
 
     def step(state: TrainState, images: jnp.ndarray, labels: jnp.ndarray,
              valid: jnp.ndarray):
+        images = device_input_epilogue(images)  # uint8 wire; eval never flips
         variables = {"params": state.params, "batch_stats": state.batch_stats}
         if workload in ("arcface", "nested"):
             # arcface inference scores are s·cosθ (no margin), arc_main.py eval
@@ -269,6 +324,7 @@ def _make_arcface_sharded_eval(cfg, model, mesh):
 
     def step(state: TrainState, images: jnp.ndarray, labels: jnp.ndarray,
              valid: jnp.ndarray):
+        images = device_input_epilogue(images)
         variables = {"params": state.params, "batch_stats": state.batch_stats}
         emb = model.apply(variables, images, train=False, method="features")
         loss_mean, t1, t3 = arc_margin_ce_sharded(
@@ -297,6 +353,7 @@ def make_predict_step(
     workload = cfg.model.head
 
     def step(state: TrainState, images: jnp.ndarray) -> jnp.ndarray:
+        images = device_input_epilogue(images)  # PLC f(x) pass: no flip
         variables = {"params": state.params, "batch_stats": state.batch_stats}
         args = (images, None) if workload in ("arcface", "nested") else (images,)
         if batch_stat_mode:
@@ -324,6 +381,7 @@ def make_nested_eval_step(
 
     def step(state: TrainState, images: jnp.ndarray, labels: jnp.ndarray,
              valid: jnp.ndarray):
+        images = device_input_epilogue(images)
         variables = {"params": state.params, "batch_stats": state.batch_stats}
         feats = model.apply(variables, images, train=False, method="features")
         # NetClassifier kernel is (D, C); the sweep wants (C, D)

@@ -20,7 +20,10 @@
 #      first uncontended capture, AND (new in r4) emits the measured
 #      roofline fields (bytes_per_step_gb / achieved_gbps /
 #      hbm_peak_frac — docs/performance.md "Roofline, measured": record
-#      the verdict there either way)
+#      the verdict there either way). Run it with --e2e (new in r5): the
+#      e2e row now carries h2d_bytes_per_step + input_dtype on the uint8
+#      wire (docs/performance.md "Wire format: uint8 H2D") — its first
+#      TPU capture is owed
 #   2. anything this file previously captured, re-run only if its code
 #      path changed since the banked artifact
 #
@@ -31,7 +34,9 @@ mkdir -p "$out"
 
 echo "== 1/2 bench (run FIRST: fresh-window numbers are the real ones —" >&2
 echo "   docs/performance.md 'Measurement variance')" >&2
-python bench.py > "$out/bench.json" 2> "$out/bench.log"
+# --e2e: also capture the uint8-wire input-path row (h2d_bytes_per_step /
+# input_dtype evidence — first TPU capture owed)
+python bench.py --e2e > "$out/bench.json" 2> "$out/bench.log"
 rc=$?
 tail -1 "$out/bench.json"
 if [ $rc -ne 0 ]; then

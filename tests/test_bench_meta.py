@@ -96,7 +96,7 @@ def test_bench_cli_has_e2e_flags():
     assert p.returncode == 0, p.stderr[-300:]
     helptext = p.stdout.decode()
     for flag in ("--e2e", "--e2e-dataset", "--e2e-images", "--e2e-root",
-                 "--device-prefetch", "--e2e-workers"):
+                 "--device-prefetch", "--e2e-workers", "--input-dtype"):
         assert flag in helptext, flag
 
 
@@ -136,6 +136,44 @@ def test_bench_e2e_row_smoke_cpu():
     # thread, produced the staged batches
     assert row["staged_batches"] >= 3
     assert row["staged_off_thread"] is True
+    # wire-format evidence: the preset default is the uint8 dataplane, and
+    # the observed per-step H2D payload is the uint8 arithmetic — 1 B/px
+    # images + i32 labels, a ~4× cut vs the float32 wire (4 B/px)
+    assert row["input_dtype"] == "uint8"
+    uint8_bytes = 16 * 32 * 32 * 3 * 1 + 16 * 4
+    float32_bytes = 16 * 32 * 32 * 3 * 4 + 16 * 4
+    assert row["h2d_bytes_per_step"] == uint8_bytes
+    assert float32_bytes / row["h2d_bytes_per_step"] > 3.9
+
+
+def test_bench_e2e_row_float32_wire_bytes():
+    """`--input-dtype float32` (the legacy wire) reports 4 B/px payloads —
+    the committed-trajectory comparison row for the ~4× claim. Driven
+    through the same `_bench_e2e_row` with a prefetch-0 synchronous pass
+    (no second compile path; the row builder reuses the uint8 smoke's
+    model shape, so the wire is the only variable)."""
+    import jax
+
+    import bench
+    from ddp_classification_pytorch_tpu.config import get_preset
+    from ddp_classification_pytorch_tpu.parallel import mesh as meshlib
+
+    cfg = get_preset("baseline")
+    cfg.model.arch = "resnet18"
+    cfg.model.variant = "cifar"
+    cfg.model.dtype = "float32"
+    cfg.data.num_classes = 8
+    cfg.data.image_size = 32
+    cfg.data.batch_size = 16
+    cfg.data.input_dtype = "float32"
+    mesh = meshlib.make_mesh()
+    row = bench._bench_e2e_row(
+        cfg, mesh, steps=1, warmup=1,
+        metric=bench._e2e_metric_name("resnet18", False, "cpu"),
+        n_chips=len(jax.devices()), dataset_kind="synthetic", root="",
+        n_images=64, src_size=0, device_prefetch=0, num_workers=1)
+    assert row["input_dtype"] == "float32"
+    assert row["h2d_bytes_per_step"] == 16 * 32 * 32 * 3 * 4 + 16 * 4
 
 
 def test_watchdog_disarm_prevents_exit():

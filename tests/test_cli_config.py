@@ -24,6 +24,14 @@ def test_transform_default_follows_workload_preset():
     assert _cfg("cdr").data.transform == "cdr"
 
 
+def test_input_dtype_flag():
+    assert _cfg("baseline").data.input_dtype == "uint8"  # wire default
+    assert _cfg("baseline", "--input_dtype", "float32").data.input_dtype == "float32"
+    assert _cfg("baseline", "--input_dtype", "uint8").data.input_dtype == "uint8"
+    with pytest.raises(SystemExit):  # argparse choices → usage error rc 2
+        _cfg("baseline", "--input_dtype", "bf16")
+
+
 def test_plc_batch_stat_predictions_flag():
     assert _cfg("plc").plc.batch_stat_predictions is False  # safe default
     assert _cfg("plc", "--plc_batch_stat_predictions").plc.batch_stat_predictions

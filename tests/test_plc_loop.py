@@ -177,9 +177,15 @@ def test_predict_pipeline_is_eval_view_with_running_stats(tmp_path):
     # manual oracle: eval-transformed images in scan order, eval-mode apply
     # (train=False → running statistics). Any regression to the train
     # transform OR to batch-stat normalization breaks this equivalence.
+    # The default uint8 wire defers normalization to the jitted predict
+    # step's epilogue, so the oracle applies the same host-side normalize.
+    from ddp_classification_pytorch_tpu.data.transforms import normalize
+
     rng = np.random.default_rng(0)
     imgs = np.stack([predict_ds.__getitem__(i, rng)[0]
                      for i in range(len(predict_ds))])
+    if imgs.dtype == np.uint8:
+        imgs = np.stack([normalize(x) for x in imgs])
     variables = {"params": tr.state.params, "batch_stats": tr.state.batch_stats}
     manual = tr.model.apply(variables, imgs, train=False)
     np.testing.assert_allclose(f_x, np.asarray(manual), rtol=1e-4, atol=1e-4)

@@ -123,6 +123,45 @@ def test_trainer_construction_config_error_exits_2():
     assert "does not cover" in p.stderr
 
 
+def _main_rc(argv, capsys):
+    """Drive cli.train.main in-process (the suite already runs on the
+    8-device CPU mesh, and `--platform cpu` skips the backend probe) and
+    return (exit code, stderr) — each construction-time case costs one
+    Trainer build attempt, not a fresh interpreter + jax import."""
+    import pytest
+
+    from ddp_classification_pytorch_tpu.cli.train import main
+
+    with pytest.raises(SystemExit) as exc:
+        main(argv)
+    return exc.value.code, capsys.readouterr().err
+
+
+def test_pipeline_arch_rejection_exits_2(capsys, tmp_path):
+    """build_model's pipeline rejection (--pp_microbatches on a non-ViT
+    arch) is config-shaped and deterministic → rc 2, not a bare rc 1
+    supervise.sh would replay with backoff (ADVICE r4)."""
+    rc, err = _main_rc(
+        ["baseline", "--dataset", "synthetic", "--platform", "cpu",
+         "--pp_microbatches", "2", "--epochs", "1",
+         "--out", str(tmp_path)], capsys)
+    assert rc == 2, err[-500:]
+    assert "config error" in err
+    assert "requires a ViT" in err
+
+
+def test_hybrid_dcn_plus_pp_rejection_exits_2(capsys, tmp_path):
+    """make_hybrid_mesh's dcn+pp rejection (the hybrid mesh is two-axis)
+    must exit 2 from Trainer construction too."""
+    rc, err = _main_rc(
+        ["baseline", "--dataset", "synthetic", "--platform", "cpu",
+         "--dcn_slices", "2", "--pp_microbatches", "2", "--pp_stages", "2",
+         "--epochs", "1", "--out", str(tmp_path)], capsys)
+    assert rc == 2, err[-500:]
+    assert "config error" in err
+    assert "does not compose" in err
+
+
 def test_catcher_stops_loudly_on_broken_probe(tmp_path):
     """rc 127 (missing interpreter) / ImportError is a broken harness, not an
     outage — the catcher must stop with that rc, not poll forever."""
