@@ -443,6 +443,83 @@ def _bench_e2e_row(cfg, mesh, *, steps: int, warmup: int, metric: str,
     }
 
 
+def _serve_metric_name(arch: str, on_accel: bool, platform: str) -> str:
+    """JSON metric name for the serving-latency row — locked by
+    tests/test_bench_meta.py so the schema cannot drift silently."""
+    return (f"{arch}_serve_latency"
+            + ("" if on_accel else f"_{platform}"))
+
+
+def _bench_serve_row(cfg, mesh, *, metric: str, n_requests: int,
+                     offered_rps: float, buckets, max_batch: int,
+                     timeout_ms: float, topk: int, seed: int = 0):
+    """Serving-path latency/throughput: the real `ServingEngine` (bounded
+    queue → deadline batcher → bucket-padded jitted predict) under a fixed
+    offered load. Buckets are compiled in warmup, so the measured window
+    contains zero compiles — the row reports end-to-end request latency
+    percentiles (submit → top-k result), achieved requests/s, and the
+    bucket histogram + fill ratio as evidence of how the batcher actually
+    packed the traffic (docs/serving.md)."""
+    import numpy as np
+
+    from ddp_classification_pytorch_tpu.serve.engine import ServingEngine
+    from ddp_classification_pytorch_tpu.serve.metrics import ServeMetrics
+    from ddp_classification_pytorch_tpu.train.state import create_train_state
+    from ddp_classification_pytorch_tpu.train.steps import make_topk_predict_step
+
+    with mesh:
+        model, _, state = create_train_state(cfg, mesh, steps_per_epoch=100)
+        predict = make_topk_predict_step(cfg, model, topk)
+        metrics = ServeMetrics(latency_window=max(n_requests, 2048))
+        engine = ServingEngine(
+            state, predict,
+            image_size=cfg.data.image_size, input_dtype=cfg.data.input_dtype,
+            max_batch=max_batch, batch_timeout_ms=timeout_ms,
+            queue_depth=max(n_requests, 64), buckets=buckets, metrics=metrics)
+        engine.warmup()  # all bucket programs compiled outside the window
+        engine.start()
+        rng = np.random.default_rng(seed)
+        h = cfg.data.image_size
+        n_distinct = min(n_requests, 16)
+        pool = (rng.integers(0, 256, (n_distinct, h, h, 3)).astype(np.uint8)
+                if cfg.data.input_dtype == "uint8"
+                else rng.normal(size=(n_distinct, h, h, 3)).astype(np.float32))
+        t0 = time.perf_counter()
+        futures = []
+        for i in range(n_requests):
+            if offered_rps:
+                # fixed offered load: pace submissions on the ideal schedule
+                lag = t0 + i / offered_rps - time.perf_counter()
+                if lag > 0:
+                    time.sleep(lag)
+            futures.append(engine.submit(pool[i % n_distinct]))
+        for f in futures:
+            f.result(timeout=120)
+        elapsed = time.perf_counter() - t0
+        engine.drain()
+
+    snap = metrics.snapshot()
+    return {
+        "metric": metric,
+        "unit": "ms",
+        "p50_ms": snap["p50_ms"],
+        "p95_ms": snap["p95_ms"],
+        "p99_ms": snap["p99_ms"],
+        "requests_per_sec": round(n_requests / elapsed, 2),
+        "offered_rps": offered_rps or 0.0,
+        "n_requests": n_requests,
+        "topk": topk,
+        "max_batch": max_batch,
+        "batch_timeout_ms": timeout_ms,
+        "buckets": list(buckets),
+        # batching evidence: how the deadline batcher actually packed the
+        # offered load, and that only bucket shapes ever ran
+        "bucket_hist": {str(k): v for k, v in sorted(snap["bucket_hist"].items())},
+        "fill_ratio": snap["fill_ratio"],
+        "compiled_buckets": sorted(engine.seen_buckets),
+    }
+
+
 DEADLINE_GRACE_S = 120.0  # slack past --deadline before the watchdog fires
 
 
@@ -522,6 +599,24 @@ def main() -> None:
                          "on-device normalization; float32 is the legacy "
                          "host-normalize wire. The row's h2d_bytes_per_step "
                          "/ input_dtype fields record what actually crossed")
+    ap.add_argument("--serve", action="store_true",
+                    help="also measure the serving path: the ServingEngine "
+                         "(bounded queue → deadline batcher → bucketed "
+                         "jitted predict, serve/engine.py) under a fixed "
+                         "offered load, emitted as an <arch>_serve_latency "
+                         "extra row (p50/p99 latency, req/s, bucket "
+                         "histogram)")
+    ap.add_argument("--serve-requests", type=int, default=256,
+                    help="requests to push through the engine for --serve")
+    ap.add_argument("--serve-rps", type=float, default=0.0,
+                    help="offered load in requests/s for --serve "
+                         "(0 = submit as fast as possible)")
+    ap.add_argument("--serve-buckets", default="1,4,16",
+                    help="comma list of padded batch shapes for --serve")
+    ap.add_argument("--serve-max-batch", type=int, default=16,
+                    help="deadline batcher's largest micro-batch for --serve")
+    ap.add_argument("--serve-timeout-ms", type=float, default=5.0,
+                    help="partial-batch flush deadline for --serve")
     args = ap.parse_args()
 
     def remaining() -> float:
@@ -728,6 +823,37 @@ def main() -> None:
                       f"{row['staged_off_thread']}", file=sys.stderr)
             except Exception as e:  # e2e must not cost the flagship line either
                 print(f"# e2e row failed: {type(e).__name__}: {e}",
+                      file=sys.stderr)
+
+    if args.serve:
+        serve_budget = 180.0  # len(buckets) predict compiles + the load run
+        if remaining() < serve_budget:
+            print(f"# skipping serve row: {remaining():.0f}s left "
+                  f"< {serve_budget:.0f}s budget", file=sys.stderr)
+        else:
+            try:
+                scfg = get_preset("baseline")
+                scfg.model.arch = args.arch
+                scfg.model.dtype = cfg.model.dtype
+                scfg.data.num_classes = 1000
+                scfg.data.image_size = cfg.data.image_size
+                buckets = tuple(int(b) for b in args.serve_buckets.split(",") if b)
+                n_req = args.serve_requests if on_accel else min(
+                    args.serve_requests, 24)
+                row = _bench_serve_row(
+                    scfg, mesh,
+                    metric=_serve_metric_name(args.arch, on_accel, platform),
+                    n_requests=n_req, offered_rps=args.serve_rps,
+                    buckets=buckets, max_batch=args.serve_max_batch,
+                    timeout_ms=args.serve_timeout_ms, topk=5)
+                extra.append(row)
+                partial_box["row"] = dict(partial_box["row"], extra=list(extra))
+                print(f"# serve row: p50 {row['p50_ms']}ms p99 "
+                      f"{row['p99_ms']}ms, {row['requests_per_sec']} req/s, "
+                      f"fill {row['fill_ratio']}, buckets "
+                      f"{row['bucket_hist']}", file=sys.stderr)
+            except Exception as e:  # serve must not cost the flagship line
+                print(f"# serve row failed: {type(e).__name__}: {e}",
                       file=sys.stderr)
 
     if probe:

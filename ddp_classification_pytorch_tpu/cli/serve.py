@@ -1,0 +1,337 @@
+"""`serve` entrypoint — stand up the micro-batching inference engine over a
+trained checkpoint (serve/engine.py; runbook: docs/serving.md).
+
+    python -m ddp_classification_pytorch_tpu.cli.serve baseline \
+        --model resnet50 --num_classes 2173 --watch runs/baseline \
+        --port 8000 --buckets 1,4,16 --batch_timeout_ms 5
+
+Discipline shared with `cli/train.py`:
+
+- deterministic config errors (bad buckets, topk > classes, a corrupt
+  `--ckpt`, construction-time ValueErrors) exit **rc 2** before/without
+  burning backend retries — supervisors must not replay them;
+- an unreachable TPU backend exits **rc 3** after the killable probe;
+- **SIGTERM/SIGINT drain gracefully**: intake stops, every already-queued
+  request is answered, metrics print one final line, exit **rc 0** — the
+  preemption-safe shutdown a supervisor can always send.
+
+`--selfcheck N` serves N synthetic requests through the full engine path
+(warmup → batcher thread → drain) and exits — the socket-free smoke the
+tier-1 tests and fresh deployments use.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import signal
+import threading
+from typing import Optional, Sequence
+
+from ..config import Config, get_preset
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="ddp_classification_pytorch_tpu.cli.serve",
+        description="micro-batched inference serving over a trained checkpoint",
+    )
+    p.add_argument("workload", choices=["baseline", "arcface", "cdr", "nested", "plc"],
+                   help="preset whose model/head the checkpoint was trained "
+                        "with (same presets as cli.train)")
+
+    m = p.add_argument_group("model")
+    m.add_argument("--model", "--arch", dest="model", default="",
+                   help="resnet18/34/50/101/152 | vgg19_bn | tresnet_m | "
+                        "vit_t16/s16/b16 (must match the checkpoint)")
+    m.add_argument("--variant", default="", help="imagenet | cifar stem")
+    m.add_argument("--dtype", default="", help="bfloat16 | float32 compute dtype")
+    m.add_argument("--num_classes", type=int, default=0)
+    m.add_argument("--image_size", type=int, default=0)
+    m.add_argument("--input_dtype", default="", choices=["", "uint8", "float32"],
+                   help="request wire format (default uint8: raw pixels, "
+                        "normalization fused into the jitted predict — same "
+                        "dataplane as training)")
+
+    s = p.add_argument_group("serving")
+    s.add_argument("--ckpt", default="",
+                   help="explicit checkpoint to serve (sha256-verified; a "
+                        "corrupt file is a deterministic rc 2)")
+    s.add_argument("--watch", default="",
+                   help="run dir to serve from AND poll for checkpoint "
+                        "hot-reload (newest verified checkpoint wins; "
+                        "corrupt candidates are quarantined, serving "
+                        "continues on the previous params)")
+    s.add_argument("--reload_poll_s", type=float, default=-1.0,
+                   help="hot-reload poll cadence for --watch (default 5)")
+    s.add_argument("--buckets", default="",
+                   help="comma list of padded batch shapes, ascending "
+                        "(e.g. 1,4,16); compile count == bucket count. "
+                        "Default: powers of two up to --max_batch")
+    s.add_argument("--max_batch", type=int, default=0,
+                   help="largest micro-batch the deadline batcher assembles "
+                        "(default 8)")
+    s.add_argument("--batch_timeout_ms", type=float, default=-1.0,
+                   help="deadline from the first queued request until a "
+                        "partial batch flushes (default 5; 0 = never wait)")
+    s.add_argument("--queue_depth", type=int, default=0,
+                   help="bounded intake queue; submits beyond it are "
+                        "rejected (backpressure / HTTP 503; default 64)")
+    s.add_argument("--topk", type=int, default=0,
+                   help="classes returned per request (default 5)")
+    s.add_argument("--port", type=int, default=-1,
+                   help=">0: stdlib HTTP front-end (POST /predict, "
+                        "GET /healthz|/metrics); default: engine only")
+    s.add_argument("--selfcheck", type=int, default=0,
+                   help="serve N synthetic requests through the full engine "
+                        "path, print metrics, drain, exit 0 (smoke mode)")
+
+    r = p.add_argument_group("run")
+    r.add_argument("--out", default="", help="metrics/records output dir")
+    r.add_argument("--tensorboard", action="store_true",
+                   help="write serve/* scalar curves to <out>/tb")
+    r.add_argument("--log_every_s", type=float, default=-1.0,
+                   help="metrics console line cadence (default 10)")
+    r.add_argument("--seed", type=int, default=-1)
+    r.add_argument("--platform", default="", choices=["", "tpu", "cpu"],
+                   help="force a JAX platform (as cli.train)")
+    return p
+
+
+def config_from_args(args: argparse.Namespace) -> Config:
+    cfg = get_preset(args.workload)
+    if args.model:
+        cfg.model.arch = args.model
+    if args.variant:
+        cfg.model.variant = args.variant
+    if args.dtype:
+        cfg.model.dtype = args.dtype
+    if args.num_classes:
+        cfg.data.num_classes = args.num_classes
+    if args.image_size:
+        cfg.data.image_size = args.image_size
+    if args.input_dtype:
+        cfg.data.input_dtype = args.input_dtype
+    if args.seed >= 0:
+        cfg.run.seed = args.seed
+    if args.out:
+        cfg.run.out_dir = args.out
+    if args.tensorboard:
+        cfg.run.tensorboard = True
+
+    sv = cfg.serve
+    if args.ckpt:
+        sv.checkpoint = args.ckpt
+    if args.watch:
+        sv.watch_dir = args.watch
+    if args.reload_poll_s >= 0:
+        sv.reload_poll_s = args.reload_poll_s
+    if args.buckets:
+        sv.buckets = tuple(int(b) for b in args.buckets.split(",") if b)
+    if args.max_batch:
+        sv.max_batch = args.max_batch
+    if args.batch_timeout_ms >= 0:
+        sv.batch_timeout_ms = args.batch_timeout_ms
+    if args.queue_depth:
+        sv.queue_depth = args.queue_depth
+    if args.topk:
+        sv.topk = args.topk
+    if args.port >= 0:
+        sv.port = args.port
+    if args.log_every_s >= 0:
+        sv.log_every_s = args.log_every_s
+
+    sv.resolve_buckets()  # raises ValueError on bad knob combinations
+    if sv.topk > cfg.data.num_classes:
+        raise ValueError(
+            f"serve.topk={sv.topk} exceeds num_classes={cfg.data.num_classes}")
+    if sv.checkpoint and sv.watch_dir:
+        raise ValueError("--ckpt and --watch are mutually exclusive: an "
+                         "explicit checkpoint pins the params, a watch dir "
+                         "hot-reloads them")
+    if not (sv.checkpoint or sv.watch_dir or args.selfcheck):
+        raise ValueError("serving needs weights: pass --ckpt <file> or "
+                         "--watch <run_dir> (or --selfcheck N to smoke the "
+                         "engine on fresh params)")
+    return cfg
+
+
+def _install_signal_handlers(stop: threading.Event):
+    """SIGTERM/SIGINT → set the drain event (the serve loop does the actual
+    drain: stop intake, flush queue, exit rc 0). Returns the previous
+    handlers so tests can restore them."""
+    prev = {}
+    for sig in (signal.SIGTERM, signal.SIGINT):
+        prev[sig] = signal.signal(sig, lambda *_: stop.set())
+    return prev
+
+
+def main(argv: Optional[Sequence[str]] = None) -> None:
+    args = build_parser().parse_args(argv)
+    try:
+        # same pre-backend rc-2 discipline as cli.train: a bad knob combo
+        # surfaces in milliseconds with the deterministic code supervisors
+        # must not retry
+        cfg = config_from_args(args)
+    except ValueError as e:
+        import sys
+
+        print(f"[serve] config error: {e}", file=sys.stderr)
+        raise SystemExit(2) from None
+    import jax
+
+    if args.platform:
+        jax.config.update("jax_platforms", args.platform)
+    else:
+        from ..utils.backend_probe import pin_platform_from_env
+
+        pin_platform_from_env()
+    if (args.platform or os.environ.get("JAX_PLATFORMS", "")) != "cpu" and (
+            os.environ.get("PALLAS_AXON_POOL_IPS")
+            or "axon" in os.environ.get("JAX_PLATFORMS", "")):
+        # same killable probe as cli.train: never hang on a dead TPU
+        from ..utils.backend_probe import require_backend
+
+        try:
+            require_backend(attempts=2, probe_timeout=120)
+        except RuntimeError as e:
+            import sys
+
+            print(f"[serve] TPU backend unreachable: {e} "
+                  "(pass --platform cpu to serve on the host)",
+                  file=sys.stderr)
+            raise SystemExit(3)
+    from ..utils.cache import enable_persistent_cache
+
+    enable_persistent_cache()
+
+    import numpy as np
+
+    from ..data.transforms import build_transform, preset_for_dataset
+    from ..parallel import mesh as meshlib
+    from ..serve.engine import ServingEngine
+    from ..serve.metrics import ServeMetrics
+    from ..serve.reload import CheckpointWatcher
+    from ..train.checkpoint import CheckpointManager
+    from ..train.state import create_train_state
+    from ..train.steps import make_topk_predict_step
+    from ..utils.logging import host0_print
+
+    mesh = meshlib.make_mesh()  # serving is pure DP: all devices on 'data'
+    try:
+        model, _, state = create_train_state(cfg, mesh, steps_per_epoch=1)
+        if cfg.serve.checkpoint:
+            # explicit checkpoint: verification failure raises ValueError —
+            # deterministic, so it maps to rc 2 like --resume in cli.train
+            mgr = CheckpointManager(
+                os.path.dirname(os.path.abspath(cfg.serve.checkpoint)) or ".",
+                save_every_epoch=False, async_save=False)
+            state = mgr.restore(state, cfg.serve.checkpoint)
+            host0_print(f"[serve] serving {cfg.serve.checkpoint}")
+    except ValueError as e:
+        import sys
+        import traceback
+
+        # construction-time ValueErrors (unknown arch/head, corrupt --ckpt,
+        # shape mismatches) are config-shaped → rc 2, same as cli.train
+        traceback.print_exc(file=sys.stderr)
+        print(f"[serve] config error: {e}", file=sys.stderr)
+        raise SystemExit(2) from None
+
+    predict = make_topk_predict_step(cfg, model, cfg.serve.topk)
+    metrics = ServeMetrics()
+    preset = preset_for_dataset(cfg.data.dataset, cfg.data.transform)
+    transform = (build_transform(preset, train=False,
+                                 image_size=cfg.data.image_size,
+                                 crop_size=cfg.data.train_crop_size,
+                                 out_dtype=cfg.data.input_dtype)
+                 if preset is not None else None)
+    engine = ServingEngine.from_config(cfg, state, predict, metrics=metrics,
+                                       transform=transform)
+
+    watcher = None
+    if cfg.serve.watch_dir:
+        watcher = CheckpointWatcher(cfg.serve.watch_dir, engine, state,
+                                    poll_s=cfg.serve.reload_poll_s,
+                                    metrics=metrics)
+        loaded = watcher.restore_initial()
+        host0_print(f"[serve] watching {cfg.serve.watch_dir} "
+                    + (f"(serving epoch {loaded})" if loaded >= 0 else
+                       "(no verified checkpoint yet; serving fresh params "
+                       "until one lands)"))
+
+    host0_print(f"[serve] arch={cfg.model.arch} classes={cfg.data.num_classes} "
+                f"wire={cfg.data.input_dtype} buckets="
+                f"{list(cfg.serve.resolve_buckets())} "
+                f"max_batch={cfg.serve.max_batch} "
+                f"timeout={cfg.serve.batch_timeout_ms}ms "
+                f"topk={cfg.serve.topk}")
+    engine.warmup()  # compile every bucket before traffic
+    host0_print(f"[serve] warm: {len(engine.buckets)} bucket programs compiled")
+
+    tb = None
+    if cfg.run.tensorboard:
+        from ..utils.tensorboard import SummaryWriter
+
+        tb = SummaryWriter(os.path.join(cfg.run.out_dir, "tb"), "serve")
+
+    if args.selfcheck:
+        engine.start()
+        rng = np.random.default_rng(cfg.run.seed)
+        h = cfg.data.image_size
+        imgs = (rng.integers(0, 256, (args.selfcheck, h, h, 3)).astype(np.uint8)
+                if cfg.data.input_dtype == "uint8"
+                else rng.normal(size=(args.selfcheck, h, h, 3)).astype(np.float32))
+        futures = [engine.submit(img) for img in imgs]
+        for f in futures:
+            f.result(timeout=120)
+        engine.drain()
+        if watcher is not None:
+            watcher.stop()
+        host0_print(metrics.log_line(engine.queue_depth))
+        if tb is not None:
+            metrics.to_tensorboard(tb, 0)
+            tb.close()
+        host0_print(f"[serve] selfcheck ok: {args.selfcheck} requests, "
+                    f"buckets used {sorted(engine.seen_buckets)}")
+        return
+
+    stop = threading.Event()
+    _install_signal_handlers(stop)
+    engine.start()
+    if watcher is not None:
+        watcher.start()
+    server = None
+    if cfg.serve.port:
+        from ..serve.http import start_server
+
+        server = start_server(engine, cfg.serve.port)
+        host0_print(f"[serve] http on :{cfg.serve.port} "
+                    "(POST /predict, GET /healthz, GET /metrics)")
+
+    step = 0
+    while not stop.wait(cfg.serve.log_every_s):
+        host0_print(metrics.log_line(engine.queue_depth))
+        if tb is not None:
+            metrics.to_tensorboard(tb, step)
+            tb.flush()
+        step += 1
+
+    # graceful drain: intake stops first (HTTP answers 503), then every
+    # already-accepted request is served, then exit 0
+    host0_print("[serve] SIGTERM/SIGINT: draining — intake stopped, "
+                f"{engine.queue_depth} request(s) queued")
+    if server is not None:
+        server.shutdown()
+    if watcher is not None:
+        watcher.stop()
+    engine.drain()
+    host0_print(metrics.log_line(engine.queue_depth))
+    if tb is not None:
+        metrics.to_tensorboard(tb, step)
+        tb.close()
+    host0_print("[serve] drained clean")
+
+
+if __name__ == "__main__":
+    main()

@@ -281,6 +281,67 @@ class RunConfig:
 
 
 @dataclass
+class ServeConfig:
+    """Inference serving (serve/ subsystem, cli/serve.py).
+
+    The engine assembles micro-batches from a bounded request queue under a
+    deadline and pads them to a small fixed set of bucket sizes, so the
+    jitted predict compiles at most len(buckets) programs — the classic
+    adaptive-batching trade (Clipper-style): `batch_timeout_ms` bounds the
+    latency a lone request pays waiting for company, `max_batch` bounds how
+    much throughput a full queue can amortize into one device dispatch.
+    """
+
+    max_batch: int = 8  # largest micro-batch the batcher assembles
+    # deadline from the FIRST queued request until a partial batch flushes;
+    # 0 = never wait (every collect takes whatever is queued right now)
+    batch_timeout_ms: float = 5.0
+    queue_depth: int = 64  # bounded intake; submits beyond it are rejected
+    # padded batch shapes (ascending). () = powers of two up to max_batch.
+    # Each bucket is one compiled program; requests pad to the smallest
+    # bucket that fits the collected batch.
+    buckets: Sequence[int] = ()
+    topk: int = 5  # classes returned per request
+    checkpoint: str = ""  # explicit checkpoint to serve (verified; rc 2 if corrupt)
+    watch_dir: str = ""  # run dir to poll for checkpoint hot-reload
+    reload_poll_s: float = 5.0  # hot-reload poll cadence
+    port: int = 0  # >0: stdlib http front-end on this port (serve/http.py)
+    log_every_s: float = 10.0  # metrics console line cadence
+
+    def resolve_buckets(self) -> tuple:
+        """Validated ascending bucket tuple (ValueError = config-shaped,
+        the serve CLI maps it to the deterministic rc 2)."""
+        if self.max_batch < 1:
+            raise ValueError(f"serve.max_batch must be >= 1, got {self.max_batch}")
+        if self.batch_timeout_ms < 0:
+            raise ValueError(
+                f"serve.batch_timeout_ms must be >= 0, got {self.batch_timeout_ms}")
+        if self.queue_depth < 1:
+            raise ValueError(f"serve.queue_depth must be >= 1, got {self.queue_depth}")
+        if self.topk < 1:
+            raise ValueError(f"serve.topk must be >= 1, got {self.topk}")
+        if self.buckets:
+            buckets = tuple(int(b) for b in self.buckets)
+        else:
+            buckets, b = [], 1
+            while b < self.max_batch:
+                buckets.append(b)
+                b *= 2
+            buckets.append(self.max_batch)
+            buckets = tuple(sorted(set(buckets)))
+        if any(b < 1 for b in buckets) or list(buckets) != sorted(set(buckets)):
+            raise ValueError(
+                f"serve.buckets must be positive and strictly ascending, "
+                f"got {buckets}")
+        if self.max_batch > buckets[-1]:
+            raise ValueError(
+                f"serve.max_batch={self.max_batch} exceeds the largest bucket "
+                f"{buckets[-1]} — a full batch would have no padded shape to "
+                "run at")
+        return buckets
+
+
+@dataclass
 class Config:
     workload: str = "baseline"  # baseline | arcface | cdr | nested | plc
     data: DataConfig = field(default_factory=DataConfig)
@@ -289,6 +350,7 @@ class Config:
     parallel: ParallelConfig = field(default_factory=ParallelConfig)
     run: RunConfig = field(default_factory=RunConfig)
     plc: PLCConfig = field(default_factory=PLCConfig)
+    serve: ServeConfig = field(default_factory=ServeConfig)
 
     def replace(self, **kw: Any) -> "Config":
         return dataclasses.replace(self, **kw)

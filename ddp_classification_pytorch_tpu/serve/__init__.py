@@ -1,0 +1,18 @@
+"""Inference serving subsystem: micro-batching engine (bounded queue +
+deadline batcher + bucketed jit), checkpoint hot-reload with quarantine,
+and serving metrics — built from the training stack's own primitives
+(jitted predict with the uint8 device epilogue, CheckpointManager's
+verified restore). Entry point: `cli/serve.py`; runbook: docs/serving.md."""
+
+from .engine import EngineClosed, Prediction, QueueFull, ServingEngine
+from .metrics import ServeMetrics
+from .reload import CheckpointWatcher
+
+__all__ = [
+    "ServingEngine",
+    "Prediction",
+    "QueueFull",
+    "EngineClosed",
+    "ServeMetrics",
+    "CheckpointWatcher",
+]

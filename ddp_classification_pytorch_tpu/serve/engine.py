@@ -1,0 +1,329 @@
+"""Micro-batching inference engine: bounded queue → deadline batcher →
+bucketed jitted predict → per-request futures.
+
+The serving problem is the inverse of training's: requests arrive one at a
+time, but the device wants big fixed-shape batches. The classic answer
+(Clipper-style adaptive batching) is what this engine implements with the
+training stack's own primitives:
+
+- **Bounded intake.** `submit()` puts a request on a `queue_depth`-bounded
+  queue and returns a `concurrent.futures.Future`; a full queue raises
+  `QueueFull` immediately (backpressure the caller — or the HTTP 503 layer —
+  can act on) instead of letting latency grow without bound.
+- **Deadline batcher.** One batcher thread collects up to `max_batch`
+  requests, waiting at most `batch_timeout_ms` past the FIRST queued request
+  before flushing a partial batch — a lone request pays bounded latency, a
+  busy queue amortizes whole batches.
+- **Bucketed compilation.** The collected batch pads (zero rows) to the
+  smallest bucket that fits, so the jitted predict sees at most
+  `len(buckets)` distinct shapes — compile count is bounded up front instead
+  of jit-per-request-count. Pad rows are discarded on return (eval-mode
+  forward has no cross-sample ops, so padding cannot perturb real rows —
+  `train/steps.py::make_topk_predict_step`).
+- **uint8 wire.** Requests cross H2D in the dataplane's wire format
+  (`data.input_dtype`, default uint8 at ¼ the bytes); normalization runs in
+  the same fused `device_input_epilogue` the train/eval steps use, with the
+  same static dtype dispatch.
+- **Atomic param swap.** `swap_state()` publishes new params which the
+  batcher adopts at the next batch boundary — the hot-reload hook
+  (serve/reload.py) never interleaves two checkpoints inside one batch.
+- **Graceful drain.** `drain()` stops intake (further submits raise
+  `EngineClosed`), flushes everything already queued, and joins the batcher
+  — the SIGTERM contract of `cli/serve.py` (exit rc 0 with no dropped
+  request).
+
+The engine is fully exercisable in-process: construct it without `start()`
+and drive `process_once()` directly — no thread, no socket (how the tier-1
+tests and `bench.py --serve` use it). The stdlib HTTP front-end
+(serve/http.py) is a thin layer over `submit()`.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+import time
+from concurrent.futures import Future
+from dataclasses import dataclass
+from typing import Any, Callable, Optional, Sequence, Tuple
+
+import numpy as np
+
+
+class QueueFull(RuntimeError):
+    """Intake queue at serve.queue_depth — backpressure, retry later."""
+
+
+class EngineClosed(RuntimeError):
+    """Engine is draining or closed — no new requests."""
+
+
+@dataclass
+class Prediction:
+    """Per-request result: top-k class indices + softmax scores."""
+
+    indices: np.ndarray  # (k,) int32
+    scores: np.ndarray   # (k,) float32
+    latency_ms: float    # submit → result, end to end
+
+
+@dataclass
+class _Request:
+    image: np.ndarray
+    future: Future
+    t_submit: float
+
+
+class ServingEngine:
+    """See module docstring. `predict` is a jitted
+    `(state, images (B,H,W,3)) -> (scores (B,k), indices (B,k))` — built by
+    `train/steps.py::make_topk_predict_step` so serving shares the training
+    stack's forward exactly."""
+
+    def __init__(
+        self,
+        state: Any,
+        predict: Callable[[Any, np.ndarray], Tuple[Any, Any]],
+        *,
+        image_size: int,
+        input_dtype: str = "uint8",
+        max_batch: int = 8,
+        batch_timeout_ms: float = 5.0,
+        queue_depth: int = 64,
+        buckets: Sequence[int] = (1, 2, 4, 8),
+        metrics: Optional[Any] = None,
+        transform: Optional[Any] = None,
+    ):
+        buckets = tuple(sorted(set(int(b) for b in buckets)))
+        if not buckets or buckets[0] < 1:
+            raise ValueError(f"buckets must be positive, got {buckets}")
+        if max_batch > buckets[-1]:
+            raise ValueError(
+                f"max_batch={max_batch} exceeds largest bucket {buckets[-1]}")
+        self._state = state
+        self._predict = predict
+        self.image_size = int(image_size)
+        self.input_dtype = input_dtype
+        self._np_dtype = np.uint8 if input_dtype == "uint8" else np.float32
+        self.max_batch = int(max_batch)
+        self.batch_timeout_s = float(batch_timeout_ms) / 1e3
+        self.buckets = buckets
+        self.transform = transform  # val Transform for submit_image decode
+        if metrics is None:
+            from .metrics import ServeMetrics
+
+            metrics = ServeMetrics()
+        self.metrics = metrics
+        self._q: "queue.Queue[_Request]" = queue.Queue(maxsize=int(queue_depth))
+        self._swap_lock = threading.Lock()
+        self._pending_state: Optional[Any] = None
+        self._closed = False
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        # evidence for the compile-count bound: which padded shapes actually
+        # ran (tests assert seen_buckets ⊆ buckets and the jit cache size)
+        self.seen_buckets: set = set()
+
+    @classmethod
+    def from_config(cls, cfg, state, predict, metrics=None, transform=None):
+        """Engine wired from a Config tree (serve + data sections)."""
+        return cls(
+            state, predict,
+            image_size=cfg.data.image_size,
+            input_dtype=cfg.data.input_dtype,
+            max_batch=cfg.serve.max_batch,
+            batch_timeout_ms=cfg.serve.batch_timeout_ms,
+            queue_depth=cfg.serve.queue_depth,
+            buckets=cfg.serve.resolve_buckets(),
+            metrics=metrics, transform=transform,
+        )
+
+    # -------------------------------------------------------------- intake --
+    @property
+    def queue_depth(self) -> int:
+        return self._q.qsize()
+
+    @property
+    def closed(self) -> bool:
+        return self._closed
+
+    def submit(self, image: Any) -> Future:
+        """Enqueue one request; resolves to a `Prediction`.
+
+        `image` must already be the wire tensor: (image_size, image_size, 3)
+        in the engine's input dtype — the shape/dtype contract is validated
+        here because a mismatched row would otherwise poison a whole padded
+        batch at jit time. Raw PIL images go through `submit_image`."""
+        if self._closed:
+            raise EngineClosed("engine is draining; intake stopped")
+        arr = np.asarray(image)
+        want = (self.image_size, self.image_size, 3)
+        if arr.shape != want or arr.dtype != self._np_dtype:
+            raise ValueError(
+                f"request must be shape {want} dtype {np.dtype(self._np_dtype)}, "
+                f"got {arr.shape} {arr.dtype} (decode with submit_image / the "
+                "val transform)")
+        req = _Request(arr, Future(), time.monotonic())
+        try:
+            self._q.put_nowait(req)
+        except queue.Full:
+            self.metrics.record_reject()
+            raise QueueFull(
+                f"intake queue full ({self._q.maxsize} pending)") from None
+        self.metrics.record_submit()
+        return req.future
+
+    def submit_image(self, img: Any) -> Future:
+        """Decode a PIL image (or anything the val transform accepts)
+        through the SAME `data.transforms.Transform` the eval pipeline uses
+        — resize/center-crop host-side, uint8 quantization for the wire —
+        then submit."""
+        if self.transform is None:
+            raise ValueError("engine has no transform; pass the val "
+                             "Transform (build_transform(train=False, "
+                             "out_dtype=input_dtype)) at construction")
+        arr = self.transform(img, np.random.default_rng(0))  # val: rng unused
+        return self.submit(arr)
+
+    # ---------------------------------------------------------- hot reload --
+    def swap_state(self, new_state: Any) -> None:
+        """Publish new params; adopted atomically at the next batch boundary
+        (serve/reload.py calls this from the watcher thread)."""
+        with self._swap_lock:
+            self._pending_state = new_state
+
+    # ------------------------------------------------------------- serving --
+    def _bucket_for(self, n: int) -> int:
+        for b in self.buckets:
+            if b >= n:
+                return b
+        return self.buckets[-1]  # unreachable: max_batch <= buckets[-1]
+
+    def _collect(self, first_timeout_s: float):
+        """Up to max_batch requests: block up to `first_timeout_s` for the
+        first, then at most batch_timeout_ms past its arrival for company."""
+        try:
+            first = (self._q.get(timeout=first_timeout_s)
+                     if first_timeout_s > 0 else self._q.get_nowait())
+        except queue.Empty:
+            return []
+        reqs = [first]
+        deadline = time.monotonic() + self.batch_timeout_s
+        while len(reqs) < self.max_batch:
+            remaining = deadline - time.monotonic()
+            try:
+                reqs.append(self._q.get(timeout=remaining)
+                            if remaining > 0 else self._q.get_nowait())
+            except queue.Empty:
+                break
+        return reqs
+
+    def _run_batch(self, reqs) -> None:
+        with self._swap_lock:
+            if self._pending_state is not None:
+                self._state = self._pending_state
+                self._pending_state = None
+        n = len(reqs)
+        bucket = self._bucket_for(n)
+        h = self.image_size
+        batch = np.zeros((bucket, h, h, 3), self._np_dtype)
+        for i, r in enumerate(reqs):
+            batch[i] = r.image
+        try:
+            scores, indices = self._predict(self._state, batch)
+            scores = np.asarray(scores)   # device sync
+            indices = np.asarray(indices)
+        except Exception as e:
+            # one bad batch must not kill the server: the requests carry the
+            # failure, the batcher keeps serving
+            self.metrics.record_error(n)
+            for r in reqs:
+                if not r.future.done():
+                    r.future.set_exception(e)
+            return
+        self.seen_buckets.add(bucket)
+        now = time.monotonic()
+        lats = []
+        for i, r in enumerate(reqs):  # pad rows [n:] are discarded here
+            lat_ms = (now - r.t_submit) * 1e3
+            lats.append(lat_ms)
+            r.future.set_result(Prediction(indices[i], scores[i], lat_ms))
+        self.metrics.record_batch(bucket, n, lats)
+
+    def process_once(self, timeout_s: float = 0.0) -> int:
+        """Collect and run ONE micro-batch inline; returns requests served
+        (0 = nothing queued). The in-process driving surface tests and
+        `drain()` use — identical code path to the batcher thread."""
+        reqs = self._collect(timeout_s)
+        if not reqs:
+            return 0
+        self._run_batch(reqs)
+        return len(reqs)
+
+    def warmup(self) -> None:
+        """Compile every bucket up front (zero batches, results discarded)
+        so the first real request never pays a compile."""
+        h = self.image_size
+        for b in self.buckets:
+            scores, _ = self._predict(
+                self._state, np.zeros((b, h, h, 3), self._np_dtype))
+            np.asarray(scores)  # block: compile belongs to warmup, not a request
+
+    def compiled_programs(self) -> Optional[int]:
+        """jit cache size of the predict fn when the runtime exposes it —
+        the at-most-len(buckets) evidence; None when it doesn't."""
+        probe = getattr(self._predict, "_cache_size", None)
+        try:
+            return int(probe()) if callable(probe) else None
+        except Exception:
+            return None
+
+    # ------------------------------------------------------------ lifecycle --
+    def start(self) -> "ServingEngine":
+        if self._thread is not None:
+            return self
+        if self._closed:
+            raise EngineClosed("cannot start a drained engine")
+
+        def loop():
+            while not self._stop.is_set():
+                self.process_once(timeout_s=0.05)
+
+        self._thread = threading.Thread(target=loop, daemon=True,
+                                        name="serve-batcher")
+        self._thread.start()
+        return self
+
+    def drain(self, timeout_s: float = 30.0) -> None:
+        """Graceful shutdown: stop intake, flush everything queued, join the
+        batcher. Every request accepted before the drain gets its result —
+        the SIGTERM rc-0 contract."""
+        self._closed = True  # submit() now raises EngineClosed
+        deadline = time.monotonic() + timeout_s
+        if self._thread is not None:
+            while not self._q.empty() and time.monotonic() < deadline:
+                time.sleep(0.005)
+            self._stop.set()
+            self._thread.join(timeout=max(deadline - time.monotonic(), 0.1))
+            self._thread = None
+        # anything left (thread raced its stop flag, or engine never started)
+        # flushes inline — same process_once the thread ran
+        while self.process_once(timeout_s=0.0):
+            pass
+
+    def close(self) -> None:
+        """Abort: stop the batcher and fail whatever is still queued
+        (EngineClosed on the pending futures). `drain()` is the graceful
+        sibling."""
+        self._closed = True
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+            self._thread = None
+        while True:
+            try:
+                req = self._q.get_nowait()
+            except queue.Empty:
+                break
+            if not req.future.done():
+                req.future.set_exception(EngineClosed("engine closed"))

@@ -1,0 +1,99 @@
+"""Optional stdlib HTTP front-end — a thin layer over the engine.
+
+The engine is the product (fully exercisable in-process, no sockets); this
+module only maps HTTP onto it with `http.server` from the standard library —
+no web framework, matching the repo's zero-new-deps rule:
+
+    POST /predict   body = an image file (anything PIL opens: JPEG/PNG)
+                    → 200 {"topk": [[class, score], ...], "latency_ms": N}
+                    → 503 when the queue is full (backpressure) or draining
+                    → 400 on undecodable bodies
+    GET  /healthz   → 200 {"ok": true, ...metrics snapshot}
+    GET  /metrics   → 200 metrics snapshot JSON
+
+`ThreadingHTTPServer` gives one handler thread per connection; every handler
+just blocks on its request future, so concurrency is bounded by the engine's
+queue, not by HTTP plumbing.
+"""
+
+from __future__ import annotations
+
+import io
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any
+
+from .engine import EngineClosed, QueueFull
+
+
+class ServeHandler(BaseHTTPRequestHandler):
+    # set by make_server on the handler class
+    engine: Any = None
+    request_timeout_s: float = 30.0
+
+    def _json(self, code: int, payload: dict) -> None:
+        body = json.dumps(payload).encode()
+        self.send_response(code)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def do_GET(self):  # noqa: N802 (BaseHTTPRequestHandler API)
+        if self.path in ("/healthz", "/metrics"):
+            snap = self.engine.metrics.snapshot(self.engine.queue_depth)
+            if self.path == "/healthz":
+                snap = {"ok": not self.engine.closed, **snap}
+            self._json(200, snap)
+            return
+        self._json(404, {"error": f"unknown path {self.path!r}"})
+
+    def do_POST(self):  # noqa: N802
+        if self.path != "/predict":
+            self._json(404, {"error": f"unknown path {self.path!r}"})
+            return
+        length = int(self.headers.get("Content-Length", 0))
+        body = self.rfile.read(length)
+        try:
+            from PIL import Image
+
+            img = Image.open(io.BytesIO(body))
+            img.load()
+        except Exception as e:
+            self._json(400, {"error": f"cannot decode image: {e}"})
+            return
+        try:
+            future = self.engine.submit_image(img)
+            pred = future.result(timeout=self.request_timeout_s)
+        except (QueueFull, EngineClosed) as e:
+            self._json(503, {"error": str(e)})
+            return
+        except Exception as e:
+            self._json(500, {"error": f"{type(e).__name__}: {e}"})
+            return
+        self._json(200, {
+            "topk": [[int(c), float(s)]
+                     for c, s in zip(pred.indices, pred.scores)],
+            "latency_ms": round(pred.latency_ms, 3),
+        })
+
+    def log_message(self, fmt, *args):  # route through one logger, not stderr spam
+        pass
+
+
+def make_server(engine: Any, port: int,
+                request_timeout_s: float = 30.0) -> ThreadingHTTPServer:
+    """Bind a ThreadingHTTPServer over `engine` (not yet serving)."""
+    handler = type("BoundServeHandler", (ServeHandler,), {
+        "engine": engine, "request_timeout_s": request_timeout_s})
+    return ThreadingHTTPServer(("0.0.0.0", port), handler)
+
+
+def start_server(engine: Any, port: int) -> ThreadingHTTPServer:
+    """Serve on a daemon thread; caller owns shutdown (`server.shutdown()`
+    before `engine.drain()` so no handler blocks on a draining engine)."""
+    server = make_server(engine, port)
+    threading.Thread(target=server.serve_forever, daemon=True,
+                     name="serve-http").start()
+    return server

@@ -1,0 +1,131 @@
+"""Serving observability: per-request / per-batch counters and latency
+percentiles for the micro-batching engine (serve/engine.py).
+
+Everything here is host-side bookkeeping — the engine records one event per
+submit/reject/batch/reload, and `snapshot()` reduces the rolling window into
+the numbers an operator (or `bench.py --serve`) actually reads: p50/p95/p99
+end-to-end latency, requests/s, batch fill ratio (real rows ÷ padded rows —
+the cost of the bucket scheme), the per-bucket batch histogram (the evidence
+that at most len(buckets) compiled shapes ever ran), queue depth, and
+reload counts. The TensorBoard surface reuses the dependency-free writer
+from `utils/tensorboard.py`; the console line goes through the same
+`utils/logging.host0_print` the trainer uses.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from typing import Dict, Optional, Sequence
+
+
+def percentile(sorted_values: Sequence[float], q: float) -> float:
+    """Nearest-rank percentile of an ascending sequence (0 when empty)."""
+    if not sorted_values:
+        return 0.0
+    i = int(round((q / 100.0) * (len(sorted_values) - 1)))
+    return float(sorted_values[i])
+
+
+class ServeMetrics:
+    """Thread-safe counters + a bounded latency window.
+
+    The window is a deque, not an unbounded list: a long-lived server must
+    not grow memory with request count, and recent-window percentiles are
+    the operationally useful ones anyway (a p99 diluted by yesterday's
+    traffic hides a regression happening now).
+    """
+
+    def __init__(self, latency_window: int = 2048):
+        self._lock = threading.Lock()
+        self.submitted = 0
+        self.completed = 0
+        self.rejected = 0  # queue-full backpressure
+        self.batches = 0
+        self.errors = 0  # predict failures (futures carry the exception)
+        self.reloads = 0  # successful hot-reload swaps
+        self.reloads_rejected = 0  # corrupt candidates quarantined
+        self.rows_real = 0
+        self.rows_padded = 0
+        self.bucket_hist: Dict[int, int] = {}  # bucket size -> batches run
+        self._lat_ms = deque(maxlen=latency_window)
+        self._done_t = deque(maxlen=latency_window)
+
+    # ------------------------------------------------------------- events --
+    def record_submit(self) -> None:
+        with self._lock:
+            self.submitted += 1
+
+    def record_reject(self) -> None:
+        with self._lock:
+            self.rejected += 1
+
+    def record_batch(self, bucket: int, n_real: int,
+                     latencies_ms: Sequence[float]) -> None:
+        now = time.monotonic()
+        with self._lock:
+            self.batches += 1
+            self.completed += n_real
+            self.rows_real += n_real
+            self.rows_padded += bucket - n_real
+            self.bucket_hist[bucket] = self.bucket_hist.get(bucket, 0) + 1
+            for lat in latencies_ms:
+                self._lat_ms.append(float(lat))
+                self._done_t.append(now)
+
+    def record_error(self, n: int = 1) -> None:
+        with self._lock:
+            self.errors += n
+
+    def record_reload(self, ok: bool) -> None:
+        with self._lock:
+            if ok:
+                self.reloads += 1
+            else:
+                self.reloads_rejected += 1
+
+    # ----------------------------------------------------------- snapshot --
+    def snapshot(self, queue_depth: Optional[int] = None) -> Dict:
+        with self._lock:
+            lat = sorted(self._lat_ms)
+            done = list(self._done_t)
+            out = {
+                "requests": self.submitted,
+                "completed": self.completed,
+                "rejected": self.rejected,
+                "batches": self.batches,
+                "errors": self.errors,
+                "reloads": self.reloads,
+                "reloads_rejected": self.reloads_rejected,
+                "bucket_hist": dict(self.bucket_hist),
+                "fill_ratio": round(
+                    self.rows_real / max(self.rows_real + self.rows_padded, 1), 4),
+            }
+        out["p50_ms"] = round(percentile(lat, 50), 3)
+        out["p95_ms"] = round(percentile(lat, 95), 3)
+        out["p99_ms"] = round(percentile(lat, 99), 3)
+        # rate over the completion window (needs two samples for a span)
+        span = done[-1] - done[0] if len(done) >= 2 else 0.0
+        out["requests_per_sec"] = round((len(done) - 1) / span, 2) if span > 0 else 0.0
+        if queue_depth is not None:
+            out["queue_depth"] = queue_depth
+        return out
+
+    def log_line(self, queue_depth: Optional[int] = None) -> str:
+        s = self.snapshot(queue_depth)
+        line = (f"[serve] reqs={s['requests']} done={s['completed']} "
+                f"rej={s['rejected']} p50={s['p50_ms']}ms p99={s['p99_ms']}ms "
+                f"rps={s['requests_per_sec']} fill={s['fill_ratio']} "
+                f"reloads={s['reloads']}")
+        if queue_depth is not None:
+            line += f" depth={queue_depth}"
+        return line
+
+    def to_tensorboard(self, writer, step: int) -> None:
+        """Scalar curves via the dependency-free event writer
+        (utils/tensorboard.py::SummaryWriter, same one the trainer uses)."""
+        s = self.snapshot()
+        for key in ("p50_ms", "p95_ms", "p99_ms", "requests_per_sec",
+                    "fill_ratio", "rejected", "reloads", "reloads_rejected"):
+            writer.add_scalar(f"serve/{key}", float(s[key]), step)

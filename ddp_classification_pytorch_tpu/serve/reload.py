@@ -1,0 +1,115 @@
+"""Checkpoint hot-reload for the serving engine.
+
+A trainer (or a supervised retrain loop) keeps writing `ckpt_eN.msgpack` +
+sha256 sidecars into a run dir; the server must pick new weights up without
+dropping traffic, and must NEVER load a corrupt/torn candidate. Both
+behaviors already exist in the training stack — this module just points them
+at the engine:
+
+- verification + quarantine are `train/checkpoint.py`'s own
+  (`CheckpointManager.restore_verified`): a candidate failing its sha256
+  sidecar or deserialization is renamed `*.corrupt` (post-mortem evidence,
+  and the scan stops matching it) and the watcher falls back to the
+  next-newest candidate — exactly the --auto_resume semantics of PR 2;
+- the swap is `ServingEngine.swap_state()`: the batcher adopts the new
+  params at a batch boundary, so no micro-batch ever mixes two checkpoints.
+
+A failed reload is therefore invisible to clients: the engine keeps serving
+the previous verified params, and the only trace is the quarantined file
+plus a `reloads_rejected` tick in the metrics.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Optional
+
+from ..train.checkpoint import CheckpointManager
+from ..utils.logging import host0_print
+
+
+class CheckpointWatcher:
+    """Polls a run dir and hot-swaps newer verified checkpoints into an
+    engine. Drive `check_once()` directly (tests, single-shot reload) or
+    `start()` a daemon poll thread (`serve.reload_poll_s` cadence)."""
+
+    def __init__(
+        self,
+        run_dir: str,
+        engine: Any,
+        template_state: Any,
+        poll_s: float = 5.0,
+        metrics: Optional[Any] = None,
+    ):
+        self.manager = CheckpointManager(
+            run_dir, save_every_epoch=False, async_save=False)
+        self.engine = engine
+        self.template = template_state
+        self.poll_s = max(float(poll_s), 0.1)
+        self.metrics = metrics
+        # newest epoch actually serving; candidates at or below it are not
+        # re-loaded (an epoch file is written once — atomic rename — so
+        # same-epoch mutation is not a case worth polling for)
+        self.loaded_epoch = -1
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def restore_initial(self) -> int:
+        """Serve the newest verified checkpoint at startup (quarantining any
+        bad ones on the way, like --auto_resume); returns the loaded epoch
+        (-1 = nothing verified yet — the engine serves its template params
+        until the first good checkpoint lands)."""
+        state, next_epoch = self.manager.restore_latest(self.template)
+        if next_epoch:
+            self.engine.swap_state(state)
+            self.loaded_epoch = next_epoch - 1
+        return self.loaded_epoch
+
+    def check_once(self) -> bool:
+        """One poll: try candidates newer than `loaded_epoch`, newest first.
+        A corrupt candidate is quarantined (`*.corrupt`) and counted as a
+        rejected reload; serving continues on the current params. Returns
+        True iff a swap happened."""
+        for e in sorted(self.manager._epoch_checkpoints(), reverse=True):
+            if e <= self.loaded_epoch:
+                break  # sorted descending: nothing newer remains
+            state = self.manager.restore_verified(
+                self.template, self.manager.epoch_path(e))
+            if state is None:  # quarantined by the manager; try next-newest
+                if self.metrics is not None:
+                    self.metrics.record_reload(ok=False)
+                host0_print(f"[serve] reload candidate epoch {e} rejected "
+                            "(quarantined); still serving "
+                            f"epoch {self.loaded_epoch}")
+                continue
+            self.engine.swap_state(state)
+            self.loaded_epoch = e
+            if self.metrics is not None:
+                self.metrics.record_reload(ok=True)
+            host0_print(f"[serve] hot-reloaded checkpoint epoch {e}")
+            return True
+        return False
+
+    # ------------------------------------------------------------- thread --
+    def start(self) -> "CheckpointWatcher":
+        if self._thread is not None:
+            return self
+
+        def loop():
+            while not self._stop.wait(self.poll_s):
+                try:
+                    self.check_once()
+                except Exception as e:  # a poll hiccup must not kill serving
+                    host0_print(f"[serve] reload poll failed: "
+                                f"{type(e).__name__}: {e}")
+
+        self._thread = threading.Thread(target=loop, daemon=True,
+                                        name="serve-reload")
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+            self._thread = None

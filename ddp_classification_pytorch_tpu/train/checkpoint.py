@@ -406,6 +406,13 @@ class CheckpointManager:
             self._quarantine(path, f"deserialization failed: {e}")
             return None
 
+    def restore_verified(self, template_state: Any, path: str) -> Optional[Any]:
+        """Public verified restore: checksum + deserialization gate with
+        quarantine-on-failure, returning None instead of raising — the
+        keep-serving-on-bad-candidate contract the hot-reload watcher
+        (serve/reload.py) shares with --auto_resume."""
+        return self._restore_verified(template_state, path)
+
     def restore_latest(self, template_state: Any) -> Tuple[Any, int]:
         """(state, next_epoch). next_epoch = 0 when nothing to restore.
 

@@ -367,6 +367,32 @@ def make_predict_step(
     return jax.jit(step)
 
 
+def make_topk_predict_step(
+    cfg: Config, model: Any, k: int
+) -> Callable[[TrainState, jnp.ndarray], Tuple[jnp.ndarray, jnp.ndarray]]:
+    """`(state, images) -> (probs (B, k) f32, indices (B, k) i32)` — the
+    serving subsystem's predict (serve/engine.py). Same forward as
+    `make_predict_step` (uint8 wire via `device_input_epilogue`, static
+    dtype dispatch, running BN stats, arcface s·cosθ scores via
+    labels=None) but the (B, C) logits never leave the device: softmax +
+    top-k run in-jit, so the D2H fetch is k floats + k ints per request
+    instead of the full class row. Eval mode has no cross-sample ops, so
+    each row depends only on its own input — bucket padding (serve's
+    fixed compile shapes) cannot perturb real rows."""
+    workload = cfg.model.head
+
+    def step(state: TrainState, images: jnp.ndarray):
+        images = device_input_epilogue(images)  # serving never flips
+        variables = {"params": state.params, "batch_stats": state.batch_stats}
+        args = (images, None) if workload in ("arcface", "nested") else (images,)
+        logits = model.apply(variables, *args, train=False)
+        probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+        vals, idx = jax.lax.top_k(probs, min(k, probs.shape[-1]))
+        return vals, idx.astype(jnp.int32)
+
+    return jax.jit(step)
+
+
 def make_nested_eval_step(
     cfg: Config, model: Any
 ) -> Callable[[TrainState, jnp.ndarray, jnp.ndarray], Dict[str, jnp.ndarray]]:

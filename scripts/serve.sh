@@ -1,0 +1,24 @@
+#!/usr/bin/env bash
+# Serving launcher — micro-batched inference over a trained run dir with
+# checkpoint hot-reload and an HTTP front-end (docs/serving.md).
+#
+# The watch dir is the SAME --out a trainer writes: new verified
+# checkpoints hot-swap between micro-batches; corrupt candidates are
+# quarantined (*.corrupt) and serving continues on the previous params.
+# SIGTERM drains gracefully (intake stops, queued requests answered,
+# exit 0) — safe to stop from a supervisor at any time.
+#
+# Usage: bash scripts/serve.sh <run_dir> [extra cli.serve flags...]
+# Env:   PORT (default 8000), BUCKETS (default 1,4,16), MAX_BATCH (16),
+#        BATCH_TIMEOUT_MS (5), TOPK (5)
+set -euo pipefail
+RUN_DIR=${1:?usage: bash scripts/serve.sh <run_dir> [flags...]}
+python -m ddp_classification_pytorch_tpu.cli.serve baseline \
+  --watch "$RUN_DIR" \
+  --port "${PORT:-8000}" \
+  --buckets "${BUCKETS:-1,4,16}" \
+  --max_batch "${MAX_BATCH:-16}" \
+  --batch_timeout_ms "${BATCH_TIMEOUT_MS:-5}" \
+  --topk "${TOPK:-5}" \
+  --out "$RUN_DIR/serve" \
+  "${@:2}"

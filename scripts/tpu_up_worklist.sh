@@ -23,7 +23,9 @@
 #      the verdict there either way). Run it with --e2e (new in r5): the
 #      e2e row now carries h2d_bytes_per_step + input_dtype on the uint8
 #      wire (docs/performance.md "Wire format: uint8 H2D") — its first
-#      TPU capture is owed
+#      TPU capture is owed. Run it with --serve too (new in r6): the
+#      serve_latency row (p50/p99, req/s, bucket histogram — the serving
+#      engine's first on-chip capture, docs/serving.md) is owed as well
 #   2. anything this file previously captured, re-run only if its code
 #      path changed since the banked artifact
 #
@@ -36,7 +38,9 @@ echo "== 1/2 bench (run FIRST: fresh-window numbers are the real ones —" >&2
 echo "   docs/performance.md 'Measurement variance')" >&2
 # --e2e: also capture the uint8-wire input-path row (h2d_bytes_per_step /
 # input_dtype evidence — first TPU capture owed)
-python bench.py --e2e > "$out/bench.json" 2> "$out/bench.log"
+# --serve: also capture the serving engine's serve_latency row (p50/p99 +
+# req/s + bucket histogram — first TPU capture owed; docs/serving.md)
+python bench.py --e2e --serve > "$out/bench.json" 2> "$out/bench.log"
 rc=$?
 tail -1 "$out/bench.json"
 if [ $rc -ne 0 ]; then

@@ -176,6 +176,66 @@ def test_bench_e2e_row_float32_wire_bytes():
     assert row["h2d_bytes_per_step"] == 16 * 32 * 32 * 3 * 4 + 16 * 4
 
 
+def test_serve_metric_name_schema():
+    """Lock the serving row's metric naming: the TPU capture must emit
+    exactly `resnet50_serve_latency`, with the standard platform suffix
+    off-accel — same convention as the e2e row."""
+    import bench
+
+    assert bench._serve_metric_name("resnet50", True, "tpu") == \
+        "resnet50_serve_latency"
+    assert bench._serve_metric_name("resnet18", False, "cpu") == \
+        "resnet18_serve_latency_cpu"
+
+
+def test_bench_cli_has_serve_flags():
+    """The --serve surface must keep parsing (the smoke below drives the
+    row builder directly, so argparse drift would otherwise go unseen)."""
+    p = subprocess.run([sys.executable, "bench.py", "--help"], cwd=REPO,
+                       capture_output=True, timeout=60)
+    assert p.returncode == 0, p.stderr[-300:]
+    helptext = p.stdout.decode()
+    for flag in ("--serve", "--serve-requests", "--serve-rps",
+                 "--serve-buckets", "--serve-max-batch", "--serve-timeout-ms"):
+        assert flag in helptext, flag
+
+
+def test_bench_serve_row_smoke_cpu():
+    """Run the serving bench path (the exact `_bench_serve_row` that
+    `bench.py --serve` calls) on the CPU backend with a tiny model, and
+    lock the emitted row's schema: the driver's regression guard keys on
+    these fields, and the bucket evidence must prove the compile-count
+    bound held."""
+    import bench
+    from ddp_classification_pytorch_tpu.config import get_preset
+    from ddp_classification_pytorch_tpu.parallel import mesh as meshlib
+
+    cfg = get_preset("baseline")
+    cfg.model.arch = "resnet18"
+    cfg.model.variant = "cifar"
+    cfg.model.dtype = "float32"
+    cfg.data.num_classes = 8
+    cfg.data.image_size = 32
+    mesh = meshlib.make_mesh()
+    row = bench._bench_serve_row(
+        cfg, mesh, metric=bench._serve_metric_name("resnet18", False, "cpu"),
+        n_requests=10, offered_rps=0.0, buckets=(2, 4), max_batch=4,
+        timeout_ms=10.0, topk=3)
+
+    assert row["metric"] == "resnet18_serve_latency_cpu"
+    assert row["unit"] == "ms"
+    assert row["p99_ms"] >= row["p95_ms"] >= row["p50_ms"] > 0
+    assert row["requests_per_sec"] > 0
+    assert row["n_requests"] == 10 and row["offered_rps"] == 0.0
+    assert row["buckets"] == [2, 4] and row["topk"] == 3
+    # bucket evidence: only bucket shapes ran (the compile-count bound),
+    # and the histogram accounts for every batch
+    assert set(row["compiled_buckets"]) <= {2, 4}
+    assert row["bucket_hist"] and all(
+        int(k) in (2, 4) for k in row["bucket_hist"])
+    assert 0 < row["fill_ratio"] <= 1.0
+
+
 def test_watchdog_disarm_prevents_exit():
     src = (
         "import time, bench\n"

@@ -150,6 +150,32 @@ def test_pipeline_arch_rejection_exits_2(capsys, tmp_path):
     assert "requires a ViT" in err
 
 
+def test_pipeline_head_rejection_exits_2(capsys, tmp_path):
+    """build_model's pipeline HEAD rejection (--pp_microbatches supports
+    fc/arcface only; the nested preset's head is 'nested') is config-shaped
+    and deterministic → rc 2 (ADVICE r4: the remaining named construction
+    errors all map like the arch rejection above)."""
+    rc, err = _main_rc(
+        ["nested", "--dataset", "synthetic", "--model", "vit_t16",
+         "--platform", "cpu", "--pp_microbatches", "2", "--epochs", "1",
+         "--out", str(tmp_path)], capsys)
+    assert rc == 2, err[-500:]
+    assert "config error" in err
+    assert "supports head=" in err
+
+
+def test_pipeline_dropout_rejection_exits_2(capsys, tmp_path):
+    """build_model's pipeline DROPOUT rejection (the tick loop carries no
+    per-tick rng) must exit 2 from Trainer construction too."""
+    rc, err = _main_rc(
+        ["baseline", "--dataset", "synthetic", "--model", "vit_t16",
+         "--dropout", "0.1", "--platform", "cpu", "--pp_microbatches", "2",
+         "--epochs", "1", "--out", str(tmp_path)], capsys)
+    assert rc == 2, err[-500:]
+    assert "config error" in err
+    assert "does not support dropout" in err
+
+
 def test_hybrid_dcn_plus_pp_rejection_exits_2(capsys, tmp_path):
     """make_hybrid_mesh's dcn+pp rejection (the hybrid mesh is two-axis)
     must exit 2 from Trainer construction too."""

@@ -61,3 +61,38 @@ def test_script_repo_references_exist():
             if not os.path.isdir(os.path.join(REPO, m.group(0))):
                 missing.append((os.path.basename(path), m.group(0)))
     assert not missing, missing
+
+
+def _script_body(name):
+    with open(os.path.join(SCRIPTS, name)) as f:
+        return "\n".join(ln for ln in f.read().splitlines()
+                         if not ln.lstrip().startswith("#"))
+
+
+def test_serve_script_flags_match_cli():
+    """scripts/serve.sh must stay in sync with cli.serve: every --flag the
+    launcher passes has to exist in the CLI parser, or the launcher breaks
+    exactly when someone reaches for it (the drift failure mode this file
+    exists to guard)."""
+    from ddp_classification_pytorch_tpu.cli.serve import build_parser
+
+    known = set()
+    for action in build_parser()._actions:
+        known.update(action.option_strings)
+    body = _script_body("serve.sh")
+    assert "ddp_classification_pytorch_tpu.cli.serve" in body
+    passed = set(re.findall(r"(?<![\w-])--[a-z_]+", body))
+    assert passed, "serve.sh passes no flags — launcher gutted?"
+    unknown = sorted(passed - known)
+    assert not unknown, f"serve.sh passes flags cli.serve rejects: {unknown}"
+
+
+def test_worklist_bench_step_captures_serve_row():
+    """The owed-work list must keep running bench with BOTH evidence rows:
+    --e2e (uint8 wire) and --serve (serve_latency) — a silently dropped
+    flag would skip the owed TPU capture without anyone noticing."""
+    body = _script_body("tpu_up_worklist.sh")
+    bench_lines = [ln for ln in body.splitlines() if "bench.py" in ln]
+    assert bench_lines, "worklist no longer runs bench.py"
+    assert any("--e2e" in ln and "--serve" in ln for ln in bench_lines), \
+        bench_lines

@@ -1,0 +1,297 @@
+"""Serving subsystem (serve/): micro-batching engine, hot-reload, drain.
+
+Everything runs in-process (no sockets) on a tiny resnet18-cifar model —
+one module-scoped state + ONE jitted predict shared by every test, so the
+bucket programs compile once for the whole file (tier-1 budget: the suite
+already outruns its 870 s window; no sleeps beyond the engine's own
+~50 ms deadlines).
+
+The acceptance pins:
+- concurrent requests through the engine are BIT-identical to the direct
+  jitted predict on the same inputs, with at most len(buckets) compiled
+  shapes observed;
+- a partial batch flushes at the deadline, padded to a bucket, and pad
+  rows cannot perturb real rows;
+- intake backpressure (bounded queue) rejects loudly;
+- hot-reload swaps a newer verified checkpoint and QUARANTINES a corrupt
+  candidate while serving continues on the old params;
+- SIGTERM drains gracefully: intake stops, queued work completes.
+"""
+
+import os
+import signal
+import threading
+import time
+from types import SimpleNamespace
+
+import numpy as np
+import pytest
+
+from ddp_classification_pytorch_tpu.config import get_preset
+from ddp_classification_pytorch_tpu.parallel import mesh as meshlib
+from ddp_classification_pytorch_tpu.serve.engine import (
+    EngineClosed,
+    QueueFull,
+    ServingEngine,
+)
+from ddp_classification_pytorch_tpu.serve.metrics import ServeMetrics
+from ddp_classification_pytorch_tpu.serve.reload import CheckpointWatcher
+from ddp_classification_pytorch_tpu.train.checkpoint import CheckpointManager
+from ddp_classification_pytorch_tpu.train.state import create_train_state
+from ddp_classification_pytorch_tpu.train.steps import make_topk_predict_step
+
+BUCKETS = (2, 4)  # every engine in this module: at most 2 compiled shapes
+
+
+@pytest.fixture(scope="module")
+def sv():
+    cfg = get_preset("baseline")
+    cfg.model.arch = "resnet18"
+    cfg.model.variant = "cifar"
+    cfg.model.dtype = "float32"
+    cfg.data.num_classes = 8
+    cfg.data.image_size = 32
+    mesh = meshlib.make_mesh()
+    model, _, state = create_train_state(cfg, mesh, steps_per_epoch=1)
+    predict = make_topk_predict_step(cfg, model, 3)
+    rng = np.random.default_rng(7)
+    imgs = rng.integers(0, 256, (8, 32, 32, 3)).astype(np.uint8)
+    return SimpleNamespace(cfg=cfg, mesh=mesh, model=model, state=state,
+                           predict=predict, imgs=imgs)
+
+
+def _engine(sv, **kw):
+    kw.setdefault("image_size", 32)
+    kw.setdefault("input_dtype", "uint8")
+    kw.setdefault("max_batch", 4)
+    kw.setdefault("batch_timeout_ms", 40.0)
+    kw.setdefault("queue_depth", 16)
+    kw.setdefault("buckets", BUCKETS)
+    kw.setdefault("metrics", ServeMetrics())
+    return ServingEngine(sv.state, sv.predict, **kw)
+
+
+def test_concurrent_requests_bit_identical_to_direct_predict(sv):
+    """4 requests submitted concurrently batch into ONE full micro-batch
+    (max_batch=4, deadline generous) and each response is bit-identical to
+    the direct jitted predict on the same 4 images stacked as one batch —
+    the engine adds batching, not numerics. Compile-count bound: only
+    bucket shapes ran, and the jit cache holds at most len(buckets)."""
+    engine = _engine(sv, batch_timeout_ms=2000.0).start()
+    try:
+        futures = [None] * 4
+        threads = [threading.Thread(target=lambda i=i: futures.__setitem__(
+            i, engine.submit(sv.imgs[i]))) for i in range(4)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        preds = [f.result(timeout=30) for f in futures]
+    finally:
+        engine.drain()
+
+    scores, indices = sv.predict(sv.state, np.stack(sv.imgs[:4]))
+    scores, indices = np.asarray(scores), np.asarray(indices)
+    for i, p in enumerate(preds):
+        np.testing.assert_array_equal(p.indices, indices[i])
+        np.testing.assert_array_equal(p.scores, scores[i])  # bitwise
+        assert p.latency_ms > 0
+    assert engine.seen_buckets == {4}
+    cache = engine.compiled_programs()
+    assert cache is None or cache <= len(BUCKETS)
+    assert engine.metrics.snapshot()["fill_ratio"] == 1.0
+
+
+def test_deadline_flushes_partial_batch(sv):
+    """3 requests < max_batch must NOT wait forever: the batcher flushes at
+    batch_timeout_ms, padded to the smallest covering bucket (4), and the
+    fill accounting records 3 real + 1 pad row."""
+    metrics = ServeMetrics()
+    engine = _engine(sv, batch_timeout_ms=50.0, metrics=metrics).start()
+    try:
+        futures = [engine.submit(sv.imgs[i]) for i in range(3)]
+        preds = [f.result(timeout=30) for f in futures]
+    finally:
+        engine.drain()
+    assert len(preds) == 3 and all(p.indices.shape == (3,) for p in preds)
+    snap = metrics.snapshot()
+    assert snap["bucket_hist"] == {4: 1}
+    assert snap["fill_ratio"] == 0.75  # 3 real rows of a 4-row bucket
+    assert snap["p99_ms"] >= snap["p50_ms"] > 0
+
+
+def test_bucket_padding_does_not_leak_into_real_rows(sv):
+    """Validity of the pad scheme: the same image answered alone (1 real +
+    1 pad row in bucket 2) and answered next to OTHER traffic (2 real rows,
+    same bucket program) must produce bitwise-identical results — pad rows
+    are dead weight, not numerics."""
+    alone = _engine(sv)
+    f = alone.submit(sv.imgs[0])
+    assert alone.process_once() == 1  # in-process drive: no thread needed
+    p_alone = f.result(timeout=30)
+    assert alone.seen_buckets == {2}
+
+    paired = _engine(sv)
+    f0 = paired.submit(sv.imgs[0])
+    paired.submit(sv.imgs[1])
+    assert paired.process_once() == 2
+    p_paired = f0.result(timeout=30)
+
+    np.testing.assert_array_equal(p_alone.indices, p_paired.indices)
+    np.testing.assert_array_equal(p_alone.scores, p_paired.scores)
+
+
+def test_queue_full_backpressure(sv):
+    """Intake is bounded: queue_depth submits are accepted, the next raises
+    QueueFull immediately (no silent latency growth) and is counted; the
+    accepted requests still complete on flush."""
+    metrics = ServeMetrics()
+    engine = _engine(sv, queue_depth=2, metrics=metrics)
+    f1, f2 = engine.submit(sv.imgs[0]), engine.submit(sv.imgs[1])
+    with pytest.raises(QueueFull):
+        engine.submit(sv.imgs[2])
+    assert metrics.snapshot()["rejected"] == 1
+    engine.drain()  # no thread: drain flushes inline
+    assert f1.result(timeout=30).indices.shape == (3,)
+    assert f2.result(timeout=30).indices.shape == (3,)
+    with pytest.raises(EngineClosed):
+        engine.submit(sv.imgs[0])
+
+
+def test_submit_validates_wire_contract(sv):
+    """A mis-shaped or mis-dtyped request fails AT SUBMIT (per-request),
+    never inside a shared padded batch at jit time."""
+    engine = _engine(sv)
+    with pytest.raises(ValueError):
+        engine.submit(sv.imgs[0].astype(np.float32))  # wrong wire dtype
+    with pytest.raises(ValueError):
+        engine.submit(np.zeros((16, 16, 3), np.uint8))  # wrong shape
+
+
+def test_hot_reload_swaps_and_quarantines_corrupt(sv, tmp_path):
+    """A newer verified checkpoint hot-swaps between batches (responses
+    change to the new params' outputs, bitwise); a newer-still CORRUPT
+    candidate is quarantined (*.corrupt) and serving continues on the last
+    verified params."""
+    import jax
+
+    run_dir = str(tmp_path)
+    mgr = CheckpointManager(run_dir, async_save=False)
+    state2 = sv.state.replace(params=jax.tree_util.tree_map(
+        lambda x: x * 1.5, sv.state.params))
+    mgr.save(state2, epoch=1)
+
+    metrics = ServeMetrics()
+    engine = _engine(sv, metrics=metrics)
+    watcher = CheckpointWatcher(run_dir, engine, sv.state, metrics=metrics)
+
+    base_scores = np.asarray(sv.predict(sv.state, np.stack(sv.imgs[:2]))[0])
+    assert watcher.check_once() is True
+    assert watcher.loaded_epoch == 1
+    f = engine.submit(sv.imgs[0])
+    engine.submit(sv.imgs[1])
+    assert engine.process_once() == 2
+    got = f.result(timeout=30)
+    # the swap took: responses now match the RELOADED params, not the old
+    reload_scores = np.asarray(
+        sv.predict(engine._state, np.stack(sv.imgs[:2]))[0])
+    np.testing.assert_array_equal(got.scores, reload_scores[0])
+    assert not np.array_equal(got.scores, base_scores[0])
+
+    # corrupt newer candidate: epoch-2 bytes torn after the sidecar landed
+    mgr.save(state2, epoch=2)
+    with open(mgr.epoch_path(2), "r+b") as fh:
+        fh.seek(100)
+        fh.write(b"\xde\xad\xbe\xef")
+    assert watcher.check_once() is False  # nothing newer verified
+    assert os.path.exists(mgr.epoch_path(2) + ".corrupt")
+    assert not os.path.exists(mgr.epoch_path(2))
+    assert watcher.loaded_epoch == 1  # still serving the verified params
+    snap = metrics.snapshot()
+    assert snap["reloads"] == 1 and snap["reloads_rejected"] == 1
+    # and the engine still answers (on the epoch-1 params)
+    f = engine.submit(sv.imgs[2])
+    assert engine.process_once() == 1
+    np.testing.assert_array_equal(
+        f.result(timeout=30).scores,
+        np.asarray(sv.predict(engine._state, np.stack(sv.imgs[2:4]))[0])[0])
+
+
+def test_sigterm_drains_gracefully(sv):
+    """The cli.serve signal contract, in-process: SIGTERM sets the drain
+    event; drain stops intake (EngineClosed), answers everything already
+    queued, and joins the batcher — no request accepted before the signal
+    is ever dropped."""
+    from ddp_classification_pytorch_tpu.cli.serve import _install_signal_handlers
+
+    stop = threading.Event()
+    prev = _install_signal_handlers(stop)
+    engine = _engine(sv, batch_timeout_ms=20.0).start()
+    try:
+        futures = [engine.submit(sv.imgs[i]) for i in range(3)]
+        os.kill(os.getpid(), signal.SIGTERM)
+        assert stop.wait(timeout=5.0), "SIGTERM handler did not fire"
+        engine.drain()
+        for f in futures:
+            assert f.result(timeout=30).indices.shape == (3,)
+        with pytest.raises(EngineClosed):
+            engine.submit(sv.imgs[0])
+    finally:
+        for sig, handler in prev.items():
+            signal.signal(sig, handler)
+
+
+def test_drain_flushes_requests_queued_after_batcher_stopped(sv):
+    """Requests still in the queue when drain begins (engine never started
+    — the worst case) are all answered before drain returns."""
+    engine = _engine(sv)
+    futures = [engine.submit(sv.imgs[i]) for i in range(5)]
+    t0 = time.monotonic()
+    engine.drain()
+    assert time.monotonic() - t0 < 30
+    assert all(f.done() for f in futures)
+    assert all(f.result().indices.shape == (3,) for f in futures)
+
+
+# ------------------------------------------------------------- cli.serve --
+
+
+def _serve_main_rc(argv, capsys):
+    from ddp_classification_pytorch_tpu.cli.serve import main
+
+    with pytest.raises(SystemExit) as exc:
+        main(argv)
+    return exc.value.code, capsys.readouterr().err
+
+
+def test_cli_serve_config_errors_exit_2(capsys):
+    """Deterministic knob errors exit rc 2 BEFORE any backend work — the
+    same discipline as cli.train, so supervisors never replay them."""
+    # max_batch beyond the largest bucket: no shape could run a full batch
+    rc, err = _serve_main_rc(
+        ["baseline", "--ckpt", "/tmp/x.msgpack", "--max_batch", "16",
+         "--buckets", "1,2,4"], capsys)
+    assert rc == 2 and "config error" in err
+    # no weights source at all
+    rc, err = _serve_main_rc(["baseline"], capsys)
+    assert rc == 2 and "config error" in err
+    # topk cannot exceed the class count
+    rc, err = _serve_main_rc(
+        ["baseline", "--ckpt", "/tmp/x.msgpack", "--num_classes", "4",
+         "--topk", "9"], capsys)
+    assert rc == 2 and "config error" in err
+
+
+def test_cli_serve_selfcheck_smoke(tmp_path, capsys):
+    """The socket-free end-to-end path: cli.serve --selfcheck builds the
+    model, warms every bucket, serves synthetic requests through the real
+    batcher thread, drains, and returns cleanly (rc 0)."""
+    from ddp_classification_pytorch_tpu.cli.serve import main
+
+    main(["baseline", "--model", "resnet18", "--variant", "cifar",
+          "--dtype", "float32", "--num_classes", "8", "--image_size", "32",
+          "--buckets", "2,4", "--max_batch", "4", "--batch_timeout_ms", "20",
+          "--selfcheck", "5", "--platform", "cpu", "--out", str(tmp_path)])
+    out = capsys.readouterr().out
+    assert "selfcheck ok: 5 requests" in out
+    assert "[serve]" in out and "p50=" in out
