@@ -42,6 +42,10 @@ def build_parser() -> argparse.ArgumentParser:
     d.add_argument("--val_dir", default="", help="explicit val dir (overrides --folder)")
     d.add_argument("--dataset", default="",
                    help="imagefolder | synthetic | plc | cifar10 | cifar100")
+    d.add_argument("--synthetic_size", type=int, default=0,
+                   help="train-set size for --dataset synthetic (default "
+                        "512); drills shrink it so multi-process restart "
+                        "cycles stay control-path-bound, not compute-bound")
     d.add_argument("--batchsize", "-b", type=int, default=0,
                    help="PER-HOST batch size; the global batch is "
                    "batchsize × num_hosts (cf. reference per-GPU batch, "
@@ -271,6 +275,8 @@ def config_from_args(args: argparse.Namespace) -> Config:
                 cfg.data.image_size = 32
             if not args.variant:
                 cfg.model.variant = "cifar"
+    if args.synthetic_size:
+        cfg.data.synthetic_size = args.synthetic_size
     if args.batchsize:
         cfg.data.batch_size = args.batchsize
     if args.num_classes:
@@ -469,7 +475,21 @@ def main(argv: Optional[Sequence[str]] = None) -> None:
             raise SystemExit(3)
         backend_up = backend_watchdog(600)
     if args.multihost:
-        jax.distributed.initialize()
+        # bounded-retry rendezvous (parallel/fleet.py): restarted hosts
+        # miss each other's window under uncoordinated supervise.sh
+        # backoffs, so initialize retries with a deterministic schedule
+        # keyed off the shared $OUT/generation file; terminal failure is
+        # rc 6 (outage-shaped — supervise.sh backs off OUTAGE_BACKOFF_S
+        # and tries again instead of giving up fast)
+        from ..parallel.fleet import RendezvousFailed, initialize_with_retry
+
+        try:
+            initialize_with_retry(out_dir=cfg.run.out_dir)
+        except RendezvousFailed as e:
+            import sys
+
+            print(f"[trainer] {e}", file=sys.stderr)
+            raise SystemExit(RendezvousFailed.exit_code) from None
     if (args.world_size is not None or args.local_rank is not None
             or args.gpu is not None):
         print("[compat] --world_size/--local_rank/--gpu are ignored on TPU: "
@@ -491,9 +511,21 @@ def main(argv: Optional[Sequence[str]] = None) -> None:
         # pretrained-checkpoint conversion are host work that can legitimately
         # exceed the watchdog on reference-scale data, and the backend is
         # already initialized at this point
+    from ..parallel.fleet import PodAbort, PodInconsistent
+
     trainer_cls = PLCTrainer if cfg.workload == "plc" else Trainer
     try:
         trainer = trainer_cls(cfg)
+    except PodInconsistent as e:
+        import sys
+
+        # rc 9 = "pod-inconsistent": the resume digest agreement failed —
+        # at least one host restored different bytes than host 0's
+        # broadcast choice. Loud and immediate instead of a silent
+        # split-brain resume; usually shared-filesystem staleness, so
+        # supervise.sh retries it with a runtime backoff.
+        print(f"[trainer] pod-inconsistent: {e}", file=sys.stderr)
+        raise SystemExit(PodInconsistent.exit_code) from None
     except ValueError as e:
         import sys
         import traceback
@@ -522,6 +554,15 @@ def main(argv: Optional[Sequence[str]] = None) -> None:
         # supervise.sh stops instead of burning the retry budget on it.
         print(f"[trainer] diverged: {e}", file=sys.stderr)
         raise SystemExit(SentinelDiverged.exit_code) from None
+    except PodAbort as e:
+        import sys
+
+        # coordinated pod stop: some host's abort intent (sentinel rc 8,
+        # deferred SIGTERM 143, …) propagated through the epoch-boundary
+        # exchange — every host exits with the SAME code, so the
+        # supervisors classify one failure, not N different ones
+        print(f"[trainer] {e}", file=sys.stderr)
+        raise SystemExit(e.code) from None
 
 
 if __name__ == "__main__":

@@ -1,0 +1,444 @@
+"""Pod-level fault tolerance: the cross-host coordination layer.
+
+Every robustness mechanism below this module is per-host — the sentinel
+and rc taxonomy (train/sentinel.py, cli/train.py), checksum-verified
+resume with quarantine (train/checkpoint.py), supervise.sh restart
+classification, and the StepHeartbeat. On a multi-host pod those pieces
+actively fight each other (the reference can only hang — a crashed
+`torch.distributed.launch` rank wedges every peer at the next collective,
+SURVEY §5):
+
+- host 0 quarantines a corrupt latest checkpoint and falls back while
+  hosts 1..N-1 independently pick a different candidate — a silent
+  split-brain resume;
+- a host that stops deterministically (rc 2/8) leaves its peers hanging
+  mid-collective until the heartbeat fires a misleading rc 7;
+- `jax.distributed.initialize()` has no retry, so uncoordinated
+  supervise.sh backoffs make restarted hosts miss each other's
+  rendezvous window forever.
+
+Four mechanisms close those gaps, all off the hot path (resume-time /
+epoch-boundary only — the step loop is untouched):
+
+1. **Resume consensus** (`consensus_restore_latest`): host 0 alone
+   scans / verifies / quarantines and broadcasts the chosen
+   (checkpoint name, next_epoch, sha256); every host restores exactly
+   that file and proves it with an all-gather digest agreement check
+   over the restored bytes. Any mismatch is the deterministic
+   `PodInconsistent` (rc 9) — never a silent divergence.
+2. **Rendezvous retry** (`initialize_with_retry`): bounded exponential
+   backoff + a hard deadline around `jax.distributed.initialize`, with
+   terminal failure mapped to `RendezvousFailed` (rc 6 — supervise.sh
+   backs off on it like an outage). A shared ``$OUT/generation`` file
+   (max-written by every host's supervisor) keeps restarted hosts on
+   the same attempt number instead of drifting apart on per-host
+   backoff.
+3. **Abort propagation** (`FleetCoordinator`): a per-epoch-boundary
+   control collective carries each host's abort intent (sentinel
+   diverged, SIGTERM received), so a deterministic stop on one host
+   becomes the SAME rc on all hosts within one epoch instead of an
+   indefinite collective hang.
+4. **Pod chaos** (utils/chaos.py `peer_dead` / `peer_slow`, gated
+   per-process by ``CHAOS_HOST``) drives the whole chain end-to-end in
+   scripts/chaos_drill.sh phase 3+.
+
+The collective primitives (`_broadcast_host` / `_allgather_host`) are
+module-level indirection so single-process unit tests stub them with
+recorded payloads; `process_count() == 1` short-circuits every protocol
+to its local equivalent, so single-host runs never pay (or need) a
+collective.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import time
+from typing import Any, Callable, Optional, Tuple
+
+import numpy as np
+
+# fixed wire sizes for the consensus broadcast (arrays must have static
+# shapes): checkpoint basename + sha256 hex digest. The whole choice
+# packs into ONE uint8 buffer → ONE collective: jaxlib 0.4.37's gloo
+# CPU transport aborts when independent collectives interleave across
+# processes, so the control plane never issues more than one at a time.
+FLAGS_BYTES = 16  # (found, next_epoch) as little-endian int64 pair
+NAME_BYTES = 256
+DIGEST_BYTES = 64
+WIRE_BYTES = FLAGS_BYTES + NAME_BYTES + DIGEST_BYTES
+
+
+# ------------------------------------------------------------ exceptions --
+class RendezvousFailed(RuntimeError):
+    """`jax.distributed.initialize` never succeeded within the retry
+    budget/deadline. rc 6 — outage-shaped (peers may simply not be up
+    yet), so supervise.sh restarts it after `OUTAGE_BACKOFF_S`."""
+
+    exit_code = 6
+
+
+class PodInconsistent(RuntimeError):
+    """The pod failed the resume digest agreement check: at least one
+    host restored different bytes (or nothing) where host 0's broadcast
+    named a verified checkpoint. rc 9 — loud and immediate, never a
+    silent split-brain resume. Usually a shared-filesystem staleness
+    race, so supervise.sh retries it with `RUNTIME_BACKOFF_S`."""
+
+    exit_code = 9
+
+
+class PodAbort(RuntimeError):
+    """Coordinated pod stop: some host carried a non-zero abort intent
+    into the epoch-boundary exchange. `code` is the process exit code
+    EVERY host exits with (the numerically largest intent across the
+    pod — deterministic on every host)."""
+
+    def __init__(self, code: int, origin: int = -1, local_code: int = 0,
+                 reason: str = ""):
+        self.code = int(code)
+        self.origin = int(origin)
+        self.local_code = int(local_code)
+        self.reason = reason
+        src = "this host" if local_code == code else f"host {origin}"
+        msg = f"pod abort rc {self.code} (from {src})"
+        if reason:
+            msg += f": {reason}"
+        super().__init__(msg)
+
+
+# ------------------------------------------------- collective primitives --
+# Thin, stubbable wrappers: unit tests monkeypatch these to simulate any
+# pod topology in one process; production resolves them against jax.
+
+def _process_index() -> int:
+    import jax
+
+    return jax.process_index()
+
+
+def _process_count() -> int:
+    import jax
+
+    return jax.process_count()
+
+
+def _broadcast_host(payload: Any) -> Any:
+    """Host-0 → everyone broadcast of a pytree of numpy arrays (the
+    control plane's only asymmetric primitive)."""
+    from jax.experimental import multihost_utils
+
+    return multihost_utils.broadcast_one_to_all(payload)
+
+
+def _allgather_host(x: np.ndarray) -> np.ndarray:
+    """All-gather a small numpy array; returns shape (process_count, ...)
+    in process-id order."""
+    from jax.experimental import multihost_utils
+
+    return np.asarray(multihost_utils.process_allgather(x))
+
+
+def _encode_fixed(text: str, size: int) -> np.ndarray:
+    raw = text.encode("utf-8")[:size]
+    out = np.zeros(size, np.uint8)
+    out[: len(raw)] = np.frombuffer(raw, np.uint8)
+    return out
+
+
+def _decode_fixed(arr: np.ndarray) -> str:
+    raw = bytes(np.asarray(arr, np.uint8))
+    return raw.rstrip(b"\x00").decode("utf-8", errors="replace")
+
+
+def pack_choice(found: int, next_epoch: int, name: str,
+                digest: str) -> np.ndarray:
+    """(found, next_epoch, basename, sha256) → one WIRE_BYTES uint8 buffer."""
+    buf = np.zeros(WIRE_BYTES, np.uint8)
+    flags = np.asarray([found, next_epoch], "<i8")
+    buf[:FLAGS_BYTES] = np.frombuffer(flags.tobytes(), np.uint8)
+    buf[FLAGS_BYTES: FLAGS_BYTES + NAME_BYTES] = _encode_fixed(name, NAME_BYTES)
+    buf[FLAGS_BYTES + NAME_BYTES:] = _encode_fixed(digest, DIGEST_BYTES)
+    return buf
+
+
+def unpack_choice(buf: np.ndarray):
+    """Inverse of `pack_choice` → (found, next_epoch, name, digest)."""
+    buf = np.asarray(buf, np.uint8)
+    flags = np.frombuffer(bytes(buf[:FLAGS_BYTES]), "<i8")
+    name = _decode_fixed(buf[FLAGS_BYTES: FLAGS_BYTES + NAME_BYTES])
+    digest = _decode_fixed(buf[FLAGS_BYTES + NAME_BYTES:])
+    return int(flags[0]), int(flags[1]), name, digest
+
+
+# ------------------------------------------------------ resume consensus --
+def consensus_restore_latest(ckpt: Any, template_state: Any) -> Tuple[Any, int]:
+    """--auto_resume for pods: one decider, one verified answer, proven.
+
+    Host 0 runs the existing scan/verify/quarantine
+    (`CheckpointManager.restore_latest_with_provenance`) and broadcasts
+    (found, next_epoch, checkpoint basename, sha256). Followers restore
+    exactly that file — `restore_exact` checks the bytes hash to the
+    broadcast digest and NEVER quarantines (exactly one host renames on
+    a corrupt candidate). Every host then contributes its restored-bytes
+    digest to an all-gather; any disagreement (a follower restored
+    different bytes, or failed to restore at all) raises
+    `PodInconsistent` (rc 9). Single-process runs take the plain
+    `restore_latest` path unchanged.
+    """
+    if _process_count() == 1:
+        return ckpt.restore_latest(template_state)
+
+    # NOTE alignment contract: between here and the final all-gather, the
+    # ONLY collectives any host may issue are the broadcast and the
+    # all-gather themselves. CheckpointManager.restore (and the leader's
+    # scan) is collective-free by construction (`_place_like` uses
+    # make_array_from_callback, never a cross-process device_put), so the
+    # leader restoring BEFORE its peers know the choice cannot desync the
+    # pod's collective streams.
+    if _process_index() == 0:
+        state, next_epoch, path, digest = (
+            ckpt.restore_latest_with_provenance(template_state))
+        found = int(path is not None)
+        payload = pack_choice(found, next_epoch,
+                              os.path.basename(path) if found else "",
+                              digest if found else "")
+    else:
+        state = template_state
+        payload = np.zeros(WIRE_BYTES, np.uint8)
+
+    found, next_epoch, name, expected = unpack_choice(_broadcast_host(payload))
+    zero_digest = np.zeros(DIGEST_BYTES, np.uint8)
+    local_digest = zero_digest
+    if found:
+        if _process_index() == 0:
+            local_digest = _encode_fixed(expected, DIGEST_BYTES)
+        else:
+            restored = ckpt.restore_exact(
+                template_state, os.path.join(ckpt.out_dir, name), expected)
+            if restored is not None:
+                state = restored
+                local_digest = _encode_fixed(expected, DIGEST_BYTES)
+                # resume best-tracking from the shared meta, like host 0
+                ckpt.best_metric = ckpt.read_meta().get(
+                    "best_metric", float("-inf"))
+        print(f"[fleet] host {_process_index()}: consensus resume "
+              f"{name} (next_epoch={next_epoch}, "
+              f"sha256={expected[:12]}…, "
+              f"restored={bool((local_digest != 0).any())})", flush=True)
+
+    gathered = _allgather_host(np.asarray(local_digest, np.uint8))
+    gathered = gathered.reshape(-1, DIGEST_BYTES)
+    agree = (gathered == gathered[0]).all()
+    if not agree:
+        bad = sorted(
+            int(p) for p in range(gathered.shape[0])
+            if not bool((gathered[p] == gathered[0]).all()))
+        raise PodInconsistent(
+            f"resume digest agreement failed: host(s) {bad} restored "
+            "different bytes than host 0's broadcast choice "
+            f"({expected[:12]}… for {name or '<fresh start>'}) — refusing a "
+            "split-brain resume (rc 9); a shared-filesystem staleness "
+            "race usually clears on the supervised retry")
+    return state, next_epoch
+
+
+# ----------------------------------------------------- rendezvous retry --
+def backoff_schedule(attempts: int, base_s: float, cap_s: float) -> list:
+    """Deterministic exponential schedule (base, 2·base, 4·base, …,
+    capped) — shared by every host, so same-generation restarts retry in
+    sync instead of drifting."""
+    return [min(base_s * (2.0 ** i), cap_s)
+            for i in range(max(attempts - 1, 0))]
+
+
+def _jax_initialize(coordinator: str, num_processes: str, process_id: str,
+                    timeout_s: int) -> None:
+    import jax
+
+    if os.environ.get("JAX_PLATFORMS", "").startswith("cpu"):
+        # multi-process CPU (tests/drills: gloo standing in for DCN) needs
+        # a cross-host collectives implementation or every multi-process
+        # computation fails with "not implemented on the CPU backend"
+        try:
+            jax.config.update("jax_cpu_collectives_implementation", "gloo")
+        except Exception:
+            pass  # jax version without the knob: TPU path unaffected
+    kw = {"initialization_timeout": int(timeout_s)}
+    if coordinator:
+        kw.update(coordinator_address=coordinator,
+                  num_processes=int(num_processes),
+                  process_id=int(process_id))
+    jax.distributed.initialize(**kw)
+
+
+def _shutdown_distributed() -> None:
+    """Best-effort teardown between rendezvous attempts — a half-open
+    client from a timed-out initialize must not poison the retry."""
+    import jax
+
+    try:
+        jax.distributed.shutdown()
+    except Exception:
+        pass
+
+
+def initialize_with_retry(
+    out_dir: str = "",
+    *,
+    initialize: Optional[Callable[[], None]] = None,
+    sleep: Callable[[float], None] = time.sleep,
+    env: Optional[dict] = None,
+) -> int:
+    """`jax.distributed.initialize` with bounded exponential backoff and
+    a hard deadline. Returns the generation this attempt belongs to
+    (from the shared ``$OUT/generation`` file — supervise.sh max-writes
+    its attempt number there before every restart, so all hosts log and
+    pace the same generation).
+
+    Knobs (env): ``FLEET_COORDINATOR`` / ``FLEET_NUM_PROCESSES`` /
+    ``FLEET_PROCESS_ID`` for explicit (non-TPU-metadata) pods,
+    ``FLEET_RENDEZVOUS_ATTEMPTS`` (5), ``FLEET_RENDEZVOUS_BACKOFF_S``
+    (5, doubling), ``FLEET_RENDEZVOUS_BACKOFF_CAP_S`` (60),
+    ``FLEET_RENDEZVOUS_TIMEOUT_S`` (60, per attempt),
+    ``FLEET_RENDEZVOUS_DEADLINE_S`` (600, hard wall across attempts).
+
+    Terminal failure raises `RendezvousFailed` (rc 6): outage-shaped —
+    the peers may simply not have restarted yet — so supervise.sh backs
+    off `OUTAGE_BACKOFF_S` and tries again rather than giving up fast.
+    """
+    e = os.environ if env is None else env
+    attempts = max(int(e.get("FLEET_RENDEZVOUS_ATTEMPTS", "5")), 1)
+    base = float(e.get("FLEET_RENDEZVOUS_BACKOFF_S", "5"))
+    cap = float(e.get("FLEET_RENDEZVOUS_BACKOFF_CAP_S", "60"))
+    timeout_s = int(float(e.get("FLEET_RENDEZVOUS_TIMEOUT_S", "60")))
+    deadline = float(e.get("FLEET_RENDEZVOUS_DEADLINE_S", "600"))
+    gen = read_generation(generation_path(out_dir)) if out_dir else 0
+    if initialize is None:
+        coordinator = e.get("FLEET_COORDINATOR", "")
+        nprocs = e.get("FLEET_NUM_PROCESSES", "")
+        pid = e.get("FLEET_PROCESS_ID", "")
+        initialize = lambda: _jax_initialize(  # noqa: E731
+            coordinator, nprocs, pid, timeout_s)
+
+    delays = backoff_schedule(attempts, base, cap)
+    start = time.monotonic()
+    last: Optional[BaseException] = None
+    for attempt in range(attempts):
+        try:
+            initialize()
+            print(f"[fleet] rendezvous ok "
+                  f"(generation={gen}, attempt={attempt + 1}/{attempts})",
+                  flush=True)
+            return gen
+        except Exception as exc:  # timeout / connection refused / barrier
+            last = exc
+            _shutdown_distributed()
+            print(f"[fleet] rendezvous attempt {attempt + 1}/{attempts} "
+                  f"failed (generation={gen}): {exc}",
+                  file=sys.stderr, flush=True)
+            if attempt < attempts - 1:
+                delay = delays[attempt]
+                if time.monotonic() - start + delay > deadline:
+                    break
+                sleep(delay)
+    raise RendezvousFailed(
+        f"rendezvous never completed (generation={gen}, "
+        f"{attempts} attempts, deadline {deadline:.0f}s): {last} — "
+        "rc 6: outage-shaped, supervise.sh backs off and retries")
+
+
+# ------------------------------------------------------ generation file --
+def generation_path(out_dir: str) -> str:
+    return os.path.join(out_dir, "generation")
+
+
+def read_generation(path: str) -> int:
+    """Current pod generation; 0 when the file is absent or garbled (a
+    torn write must not brick the restart chain)."""
+    try:
+        with open(path) as f:
+            return max(int(f.read().strip() or 0), 0)
+    except (OSError, ValueError):
+        return 0
+
+
+def advance_generation(path: str, target: int) -> int:
+    """Monotonic max-write: records `target` only when it exceeds the
+    current value (atomic tmp+replace; concurrent writers observing the
+    same generation write the same value and converge). Returns the
+    resulting generation. supervise.sh performs the same operation in
+    shell before each restart."""
+    target = int(target)
+    cur = read_generation(path)
+    if target <= cur:
+        return cur
+    parent = os.path.dirname(path)
+    if parent:
+        os.makedirs(parent, exist_ok=True)
+    tmp = f"{path}.tmp.{os.getpid()}"
+    with open(tmp, "w") as f:
+        f.write(str(target) + "\n")
+    os.replace(tmp, path)
+    return target
+
+
+# ---------------------------------------------------- abort propagation --
+class FleetCoordinator:
+    """Epoch-boundary abort propagation.
+
+    Each host accumulates at most one abort intent (`note_abort`): the
+    sentinel's rc 8, a deferred SIGTERM (143), a config-shaped stop.
+    At every epoch boundary — BEFORE eval/checkpoint, an aligned point
+    every host reaches after the same number of step collectives —
+    `check()` all-gathers the intents; any non-zero intent raises
+    `PodAbort` on EVERY host with the same deterministic code (the
+    numerically largest intent), so one host's stop becomes the pod's
+    stop within one epoch instead of an indefinite hang at the next
+    collective (and never a misleading heartbeat rc 7).
+
+    One tiny int32 all-gather per epoch: strictly off the hot path.
+    Single-process pods short-circuit (no collective), making the class
+    inert-but-testable everywhere.
+    """
+
+    def __init__(self, process_index: Optional[int] = None,
+                 process_count: Optional[int] = None):
+        self.process_index = (_process_index() if process_index is None
+                              else int(process_index))
+        self.process_count = (_process_count() if process_count is None
+                              else int(process_count))
+        self.abort_code = 0
+        self.abort_reason = ""
+
+    def note_abort(self, code: int, reason: str = "") -> None:
+        """Record this host's abort intent (first one wins — the cause,
+        not the last symptom)."""
+        if code and not self.abort_code:
+            self.abort_code = int(code)
+            self.abort_reason = reason
+            print(f"[fleet] host {self.process_index}: abort intent "
+                  f"rc {self.abort_code}"
+                  + (f" ({reason})" if reason else "")
+                  + " — propagating at the epoch boundary", flush=True)
+
+    def exchange_abort(self) -> Tuple[int, int]:
+        """(pod_code, origin): the largest intent across the pod and the
+        lowest host index carrying it; (0, -1) when nobody aborts."""
+        local = np.asarray([self.abort_code], np.int32)
+        if self.process_count == 1:
+            codes = local
+        else:
+            codes = _allgather_host(local).reshape(-1)[: self.process_count]
+        code = int(codes.max()) if codes.size else 0
+        if not code:
+            return 0, -1
+        return code, int(np.argmax(codes == code))
+
+    def check(self) -> None:
+        """Run the epoch-boundary exchange; raise `PodAbort` when any
+        host (including this one) carries an intent."""
+        code, origin = self.exchange_abort()
+        if code:
+            raise PodAbort(code, origin=origin, local_code=self.abort_code,
+                           reason=self.abort_reason)

@@ -41,12 +41,27 @@ def _sha256_file(path: str, chunk: int = 1 << 20) -> str:
 
 
 def _place_like(template: Any, restored: Any) -> Any:
-    """device_put each restored (numpy) leaf onto the template leaf's sharding."""
-    return jax.tree_util.tree_map(
-        lambda t, n: jax.device_put(n, t.sharding) if hasattr(t, "sharding") else n,
-        template,
-        restored,
-    )
+    """Place each restored (numpy) leaf onto the template leaf's sharding —
+    COLLECTIVE-FREE by construction. `jax.device_put` onto a
+    non-fully-addressable sharding runs a hidden cross-process
+    `assert_equal` broadcast, which would force every host to enter
+    restore in lockstep; the pod resume consensus (parallel/fleet.py)
+    specifically needs host 0 to restore BEFORE its peers know the
+    choice, so non-addressable leaves go through
+    `make_array_from_callback` instead (each process fills only its
+    addressable shards from the full host copy — no communication)."""
+    import numpy as np
+
+    def put(t, n):
+        if not hasattr(t, "sharding"):
+            return n
+        if getattr(t.sharding, "is_fully_addressable", True):
+            return jax.device_put(n, t.sharding)
+        arr = np.asarray(n)
+        return jax.make_array_from_callback(
+            arr.shape, t.sharding, lambda idx, a=arr: a[idx])
+
+    return jax.tree_util.tree_map(put, template, restored)
 
 
 def _replicated_gather(mesh):
@@ -178,7 +193,30 @@ class CheckpointManager:
             return "corrupt"
         if not re.fullmatch(r"[0-9a-f]{64}", expected):
             return "corrupt"
-        return "ok" if _sha256_file(path) == expected else "corrupt"
+        try:
+            actual = _sha256_file(path)
+        except OSError:
+            # shared filesystem: another host quarantined (renamed) the
+            # file between our existence check and the hash — treat it
+            # like any other failed candidate instead of crashing the
+            # restart chain
+            return "corrupt"
+        return "ok" if actual == expected else "corrupt"
+
+    def file_digest(self, path: str) -> str:
+        """sha256 of a checkpoint's bytes: the verified sidecar when one
+        exists (already proven to match), else hashed directly (legacy
+        files) — the provenance the pod resume consensus broadcasts."""
+        sidecar = self.checksum_path(path)
+        if os.path.exists(sidecar):
+            try:
+                with open(sidecar) as f:
+                    expected = f.read().strip()
+                if re.fullmatch(r"[0-9a-f]{64}", expected):
+                    return expected
+            except OSError:
+                pass
+        return _sha256_file(path)
 
     def _quarantine(self, path: str, reason: str) -> None:
         """Rename a corrupt/torn checkpoint (and its sidecar) to *.corrupt
@@ -189,7 +227,10 @@ class CheckpointManager:
         try:
             os.replace(path, dst)
         except OSError:
-            return  # another host already moved it
+            # shared-filesystem rename race: another host already moved
+            # it (FileNotFoundError) — the second rename is a no-op, the
+            # pod must end up with exactly one *.corrupt file
+            return
         sidecar = self.checksum_path(path)
         if os.path.exists(sidecar):
             try:
@@ -420,19 +461,49 @@ class CheckpointManager:
         or torn one is quarantined (renamed *.corrupt) and the next-newest
         VERIFIED checkpoint wins — a bad latest checkpoint costs one epoch
         of progress, not the whole retry budget."""
+        state, next_epoch, _, _ = self.restore_latest_with_provenance(
+            template_state)
+        return state, next_epoch
+
+    def restore_latest_with_provenance(
+            self, template_state: Any) -> Tuple[Any, int, Optional[str],
+                                                Optional[str]]:
+        """`restore_latest` that also reports WHAT it restored:
+        (state, next_epoch, path, sha256-digest), with (None, None) for
+        the path/digest on a fresh start. The pod resume consensus
+        (parallel/fleet.py) runs this on host 0 only and broadcasts the
+        provenance so every follower restores the identical file."""
         self.wait()
         for e in sorted(self._epoch_checkpoints(), reverse=True):
-            state = self._restore_verified(template_state, self.epoch_path(e))
+            path = self.epoch_path(e)
+            state = self._restore_verified(template_state, path)
             if state is None:
                 continue
             # resume best-tracking too, or the first post-resume epoch would
             # clobber ckpt_best regardless of its metric
             self.best_metric = self.read_meta().get("best_metric", float("-inf"))
-            return state, e + 1
+            return state, e + 1, path, self.file_digest(path)
         if os.path.exists(self.best_path):
             state = self._restore_verified(template_state, self.best_path)
             if state is not None:
                 meta = self.read_meta()
                 self.best_metric = meta.get("best_metric", float("-inf"))
-                return state, int(meta.get("best_epoch", -1)) + 1
-        return template_state, 0
+                return (state, int(meta.get("best_epoch", -1)) + 1,
+                        self.best_path, self.file_digest(self.best_path))
+        return template_state, 0, None, None
+
+    def restore_exact(self, template_state: Any, path: str,
+                      expected_digest: str) -> Optional[Any]:
+        """Follower-side consensus restore: restore `path` iff its bytes
+        hash to `expected_digest` (host 0's broadcast choice); None on a
+        missing/mismatched/undeserializable file. Deliberately never
+        quarantines — scan-and-rename is host 0's job alone, so a corrupt
+        candidate produces exactly ONE *.corrupt rename across the pod;
+        a follower's failure surfaces through the fleet digest agreement
+        check (rc 9) instead."""
+        try:
+            if _sha256_file(path) != expected_digest:
+                return None
+            return self.restore(template_state, path, verify=False)
+        except (OSError, ValueError, KeyError, EOFError):
+            return None

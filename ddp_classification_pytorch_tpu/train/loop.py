@@ -48,12 +48,13 @@ from ..data.native import NativeBatcher
 from ..data.synthetic import SyntheticDataset
 from ..data.transforms import build_transform
 from ..ops.nested import best_k
+from ..parallel import fleet as fleetlib
 from ..parallel import mesh as meshlib
 from ..utils import chaos as chaoslib
 from ..utils.backend_probe import StepHeartbeat
 from ..utils.logging import EtaLogger, RecordWriter, host0_print, is_host0
 from .checkpoint import CheckpointManager
-from .sentinel import StepSentinel
+from .sentinel import SentinelDiverged, StepSentinel
 from .state import create_train_state, param_count
 from .steps import make_eval_step, make_nested_eval_step, make_train_step
 
@@ -178,9 +179,18 @@ class Trainer:
         # one-shot state persists under <out_dir>/chaos so a supervised
         # restart does not replay host-side faults. A malformed spec raises
         # ValueError here — construction-time, so the CLI maps it to rc 2.
-        self.chaos = chaoslib.plan_for_run(cfg.run.fault_spec, cfg.run.out_dir)
+        # process_index feeds the CHAOS_HOST per-host gate on pod drills.
+        self.chaos = chaoslib.plan_for_run(cfg.run.fault_spec, cfg.run.out_dir,
+                                           process_index=jax.process_index())
         if self.chaos:
             host0_print(f"[chaos] fault plan active: {self.chaos}")
+        # pod coordination (parallel/fleet.py): epoch-boundary abort
+        # propagation + SIGTERM deferral, multi-process runs only — a
+        # single-process Trainer keeps today's behavior bit-for-bit
+        self.fleet = (fleetlib.FleetCoordinator()
+                      if jax.process_count() > 1 else None)
+        if self.fleet is not None:
+            self._defer_sigterm_to_epoch_boundary()
         # non-finite step policy: skip counting + rc-8 escalation
         # (train/sentinel.py); the streak carries across epochs
         self.sentinel = StepSentinel(cfg.run.max_bad_steps)
@@ -255,8 +265,15 @@ class Trainer:
             host0_print(f"resumed from {cfg.run.resume} at epoch {self.start_epoch}")
         elif cfg.run.auto_resume:
             # preemption recovery: restart command == start command; fresh
-            # runs fall through with start_epoch 0 (nothing in out_dir yet)
-            self.state, self.start_epoch = self.ckpt.restore_latest(self.state)
+            # runs fall through with start_epoch 0 (nothing in out_dir yet).
+            # On pods this is the resume CONSENSUS: host 0 alone scans/
+            # verifies/quarantines and broadcasts its choice; every host
+            # restores that exact file and the pod proves agreement with an
+            # all-gathered digest (mismatch ⇒ PodInconsistent, rc 9 at the
+            # CLI — never a silent split-brain resume). Single-process runs
+            # take the plain restore_latest path unchanged.
+            self.state, self.start_epoch = fleetlib.consensus_restore_latest(
+                self.ckpt, self.state)
             if self.start_epoch:
                 host0_print(
                     f"auto-resumed from {cfg.run.out_dir} at epoch {self.start_epoch}")
@@ -278,6 +295,40 @@ class Trainer:
             f"mesh={dict(zip(self.mesh.axis_names, self.mesh.devices.shape))} "
             f"steps/epoch={self.steps_per_epoch}"
         )
+
+    # ---------------------------------------------------------------- fleet --
+    def _defer_sigterm_to_epoch_boundary(self) -> None:
+        """Pod-mode SIGTERM: record abort intent instead of dying
+        mid-collective. A single host exiting mid-epoch leaves its peers
+        hanging at the next step's collective (the reference's fate);
+        deferring to the epoch-boundary abort exchange turns one host's
+        preemption into the SAME rc 143 on every host, which the
+        supervisors then restart into one coordinated generation.
+        Single-host runs keep the default die-now semantics."""
+        import signal
+        import threading
+
+        if threading.current_thread() is not threading.main_thread():
+            return  # tests construct Trainers off-thread; signals need main
+
+        def on_sigterm(signum, frame):
+            self.fleet.note_abort(143, "SIGTERM received (preemption)")
+
+        signal.signal(signal.SIGTERM, on_sigterm)
+
+    def _sentinel_flush(self) -> None:
+        """`sentinel.flush`, pod-aware: single-host raises straight to the
+        CLI (rc 8, today's behavior); on a pod the divergence becomes
+        abort intent and THIS host keeps issuing the epoch's remaining
+        step collectives — its updates are identity while non-finite, and
+        stopping early would hang every peer mid-epoch. The intent
+        surfaces as rc 8 on every host at the epoch-boundary exchange."""
+        try:
+            self.sentinel.flush()
+        except SentinelDiverged as e:
+            if self.fleet is None:
+                raise
+            self.fleet.note_abort(SentinelDiverged.exit_code, str(e))
 
     # -------------------------------------------------------------- profile --
     def _setup_profiler(self) -> None:
@@ -336,6 +387,8 @@ class Trainer:
                 if self.chaos:
                     self._host_step += 1
                     self.chaos.maybe_sigterm(step=self._host_step - 1)
+                    self.chaos.maybe_peer_dead(step=self._host_step - 1)
+                    self.chaos.maybe_peer_slow(step=self._host_step - 1)
                 if step % self.cfg.run.log_every == 0:
                     if eta is not None:
                         # the only host sync per log_every steps (reference
@@ -344,8 +397,10 @@ class Trainer:
                                       **{k: float(v) for k, v in metrics.items()})
                     # flush is a device round-trip too, so reaching here is
                     # proof the backend is answering — heartbeat it. It also
-                    # raises SentinelDiverged on a sustained-NaN streak.
-                    self.sentinel.flush()
+                    # raises SentinelDiverged on a sustained-NaN streak
+                    # (pod mode: noted as abort intent instead — see
+                    # _sentinel_flush).
+                    self._sentinel_flush()
                     self._heartbeat.touch()
         finally:
             # a mid-epoch exception (divergence, injected fault, loader IO)
@@ -353,7 +408,7 @@ class Trainer:
             # keep the old epoch's H2D copies running across a supervise.sh
             # restart
             it.close()
-        self.sentinel.flush()
+        self._sentinel_flush()
         if sums is None:
             return {"loss": 0.0, "top1": 0.0, "top3": 0.0,
                     "step_ok": 1.0, "grad_norm": 0.0}
@@ -430,6 +485,16 @@ class Trainer:
         for epoch in range(self.start_epoch, cfg.run.epochs):
             t0 = time.time()
             train_m = self.train_epoch(epoch, eta)
+            if self.fleet is not None:
+                # epoch-boundary control collective (the ONLY per-epoch
+                # pod sync): every host arrives here after the same number
+                # of step collectives, exchanges abort intent, and raises
+                # the same PodAbort rc when any host carries one — a
+                # deterministic stop propagates within one epoch instead
+                # of hanging peers (or tripping a misleading heartbeat
+                # rc 7). Runs BEFORE eval/save so a diverged epoch is
+                # neither evaluated nor checkpointed.
+                self.fleet.check()
             val_m = self.evaluate() if (epoch + 1) % cfg.run.eval_every == 0 else {}
             last = {**train_m, **val_m, "epoch_time": time.time() - t0}
             host0_print(

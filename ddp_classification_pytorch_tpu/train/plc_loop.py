@@ -231,6 +231,10 @@ class PLCTrainer(Trainer):
         last: Dict[str, float] = {}
         for epoch in range(self.start_epoch, cfg.run.epochs):
             train_m = self.train_epoch(epoch, eta_log)
+            if self.fleet is not None:
+                # epoch-boundary pod abort exchange (see Trainer.run):
+                # before the correction pass, which is collective-bearing
+                self.fleet.check()
             changed = 0
             if epoch + 1 > cfg.plc.warmup_epochs:
                 changed = self.correct_labels()

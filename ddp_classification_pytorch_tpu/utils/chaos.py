@@ -23,14 +23,29 @@ drives injection hooks planted at four points:
   batch/epoch, the transient-crash shape supervise.sh retries (rc 1).
 - ``sigterm`` — the step loop SIGTERMs its own process on the matching
   global step: a mid-epoch preemption.
+- ``peer_dead`` — the step loop SIGKILLs its own process on the matching
+  global step: a host dropping out of a pod with no cleanup, the
+  scenario that leaves every peer hanging at its next collective (the
+  reference's single worst failure mode — SURVEY §5).
+- ``peer_slow`` — the step loop sleeps ``CHAOS_PEER_SLOW_S`` seconds
+  (default 15) on the matching global step: a straggling host.
 
 Ranges: ``@step=7`` (one step), ``@step=7..9`` (inclusive), ``@step=7..``
-(every step from 7 on). Host-side faults (ckpt_io / loader_io / sigterm)
-fire AT MOST ONCE per fault — in-process, and across restarts when a
-``state_dir`` is given (a marker file per fired fault), so a supervised
-run converges to a clean exit instead of deterministically replaying the
-injected crash. The spec is env-overridable (``CHAOS_FAULT_SPEC``) so a
-drill can wrap any existing launch script unchanged.
+(every step from 7 on). Host-side faults (ckpt_io / loader_io / sigterm /
+peer_dead / peer_slow) fire AT MOST ONCE per fault — in-process, and
+across restarts when a ``state_dir`` is given (a marker file per fired
+fault), so a supervised run converges to a clean exit instead of
+deterministically replaying the injected crash. The spec is
+env-overridable (``CHAOS_FAULT_SPEC``) so a drill can wrap any existing
+launch script unchanged.
+
+Pod drills share ONE spec across every host and aim faults with the
+``CHAOS_HOST`` env var: when set, faults fire only on the process whose
+``jax.process_index()`` equals it (the trainer passes its index to
+``plan_for_run``); unset means every host, which is bit-identical to the
+pre-pod behavior. ``nan_loss`` windows honor the same gate (the gated
+host compiles the injection, peers compile the clean step) so a drill
+can stage a one-host divergence.
 
 An empty/absent spec parses to a falsy plan and every call site gates on
 it, so production runs take bit-for-bit the code path they take today
@@ -45,11 +60,14 @@ import sys
 from dataclasses import dataclass
 from typing import List, Optional, Tuple
 
-KINDS = ("nan_loss", "ckpt_io", "loader_io", "sigterm")
+KINDS = ("nan_loss", "ckpt_io", "loader_io", "sigterm", "peer_dead",
+         "peer_slow")
 UNITS = ("step", "epoch", "batch")
 
 ENV_SPEC = "CHAOS_FAULT_SPEC"
 ENV_STATE_DIR = "CHAOS_STATE_DIR"
+ENV_HOST = "CHAOS_HOST"
+ENV_PEER_SLOW_S = "CHAOS_PEER_SLOW_S"
 
 
 def resolve_spec(config_spec: str = "") -> str:
@@ -103,13 +121,16 @@ class FaultPlan:
     nothing and changes nothing.
     """
 
-    def __init__(self, faults: List[Fault], state_dir: Optional[str] = None):
+    def __init__(self, faults: List[Fault], state_dir: Optional[str] = None,
+                 process_index: int = 0):
         self.faults = list(faults)
         self.state_dir = state_dir
+        self.process_index = int(process_index)
         self._fired: set = set()
 
     @classmethod
-    def parse(cls, spec: str, state_dir: Optional[str] = None) -> "FaultPlan":
+    def parse(cls, spec: str, state_dir: Optional[str] = None,
+              process_index: int = 0) -> "FaultPlan":
         """``kind@unit=range[,kind@unit=range...]`` → FaultPlan.
 
         Raises ValueError on malformed specs — surfaced at trainer
@@ -137,8 +158,11 @@ class FaultPlan:
             if kind == "nan_loss" and unit != "step":
                 raise ValueError("nan_loss is keyed by the in-jit step "
                                  "counter; use nan_loss@step=...")
+            if kind in ("peer_dead", "peer_slow") and unit != "step":
+                raise ValueError(f"{kind} is keyed by the host-side step "
+                                 f"counter; use {kind}@step=...")
             faults.append(Fault(kind, unit, lo, hi))
-        return cls(faults, state_dir=state_dir)
+        return cls(faults, state_dir=state_dir, process_index=process_index)
 
     def __bool__(self) -> bool:
         return bool(self.faults)
@@ -146,9 +170,26 @@ class FaultPlan:
     def __str__(self) -> str:
         return ",".join(str(f) for f in self.faults)
 
+    # ---------------------------------------------------------- host gate --
+    def host_gated(self) -> bool:
+        """True when ``CHAOS_HOST`` is set and names a DIFFERENT process:
+        this plan's faults belong to another host of the pod. Unset (the
+        single-host default) gates nothing."""
+        target = os.environ.get(ENV_HOST, "")
+        if target == "":
+            return False
+        try:
+            return int(target) != self.process_index
+        except ValueError:
+            return False
+
     # --------------------------------------------------------------- state --
     def _marker(self, fault: Fault) -> Optional[str]:
-        return (os.path.join(self.state_dir, fault.key)
+        # markers are per-host: on a pod the state_dir rides the SHARED
+        # out_dir, and host A firing a fault must not consume host B's
+        # one shot (one-shot means once per fault PER PROCESS)
+        return (os.path.join(self.state_dir,
+                             f"{fault.key}.h{self.process_index}")
                 if self.state_dir else None)
 
     def _already_fired(self, fault: Fault) -> bool:
@@ -171,7 +212,10 @@ class FaultPlan:
         """One-shot host-side trigger: the first un-fired fault of `kind`
         whose unit is present in `coords` and whose range matches. Marks
         it fired (in memory, and in state_dir when configured) before
-        returning it."""
+        returning it. ``CHAOS_HOST`` gating: a plan aimed at another
+        host never fires (and never consumes its one shot)."""
+        if self.host_gated():
+            return None
         for f in self.faults:
             if (f.kind == kind and f.unit in coords
                     and f.matches(int(coords[f.unit]))
@@ -184,7 +228,11 @@ class FaultPlan:
     def windows(self, kind: str, unit: str = "step") -> List[Tuple[int, Optional[int]]]:
         """(lo, hi) ranges for in-jit injection (hi None = open-ended).
         NOT one-shot: a pure function of the step counter, like a real
-        divergence."""
+        divergence. ``CHAOS_HOST`` gating applies at trace time: the
+        targeted host compiles the injection, its peers compile the
+        clean step — how a pod drill stages a ONE-host divergence."""
+        if self.host_gated():
+            return []
         return [(f.lo, f.hi) for f in self.faults
                 if f.kind == kind and f.unit == unit]
 
@@ -219,13 +267,40 @@ class FaultPlan:
                   file=sys.stderr, flush=True)
             os.kill(os.getpid(), signal.SIGTERM)
 
+    def maybe_peer_dead(self, *, step: int) -> None:
+        """Step-loop hook: SIGKILL self — a host dropping out of the pod
+        with no cleanup (no atexit, no flush, rc 137), so the pod chaos
+        drill stages the peers-hang-at-the-next-collective scenario."""
+        f = self.should_fire("peer_dead", step=step)
+        if f is not None:
+            print(f"# chaos: host {self.process_index} dies (SIGKILL) at "
+                  f"step {step} ({f})", file=sys.stderr, flush=True)
+            os.kill(os.getpid(), signal.SIGKILL)
 
-def plan_for_run(config_spec: str, out_dir: str) -> FaultPlan:
+    def maybe_peer_slow(self, *, step: int) -> None:
+        """Step-loop hook: stall this host ``CHAOS_PEER_SLOW_S`` seconds
+        (default 15) — a straggler; its peers block at the step's
+        collective, and nothing should escalate unless the stall
+        exceeds the heartbeat."""
+        f = self.should_fire("peer_slow", step=step)
+        if f is not None:
+            import time
+
+            stall = float(os.environ.get(ENV_PEER_SLOW_S, "15"))
+            print(f"# chaos: host {self.process_index} stalls {stall:.0f}s "
+                  f"at step {step} ({f})", file=sys.stderr, flush=True)
+            time.sleep(stall)
+
+
+def plan_for_run(config_spec: str, out_dir: str,
+                 process_index: int = 0) -> FaultPlan:
     """The trainer's entry point: resolve the spec (env wins), persist
     one-shot firing state under ``<out_dir>/chaos`` so a supervised
     restart does not replay host-side faults (``CHAOS_STATE_DIR``
-    overrides the location)."""
+    overrides the location). `process_index` feeds the ``CHAOS_HOST``
+    per-host gate on pods."""
     spec = resolve_spec(config_spec)
     if not spec:
         return FaultPlan([])
-    return FaultPlan.parse(spec, state_dir=os.path.join(out_dir, "chaos"))
+    return FaultPlan.parse(spec, state_dir=os.path.join(out_dir, "chaos"),
+                           process_index=process_index)

@@ -1,8 +1,9 @@
 #!/usr/bin/env bash
 # End-to-end chaos drill: prove the recovery chain — supervise.sh restarts,
 # --auto_resume with checksum-verified fallback, the non-finite step
-# sentinel, and rc classification — against INJECTED faults instead of
-# trusting it (docs/operations.md "Chaos drill").
+# sentinel, rc classification, and (phases 3-5) the POD fault-tolerance
+# layer (parallel/fleet.py) — against INJECTED faults instead of trusting
+# it (docs/operations.md "Chaos drill").
 #
 # Phase 1 (must converge to rc 0): a NaN-loss burst (skipped by the
 # sentinel), a loader IO failure (rc 1, restarted with backoff), a torn
@@ -13,20 +14,54 @@
 # Phase 2 (must stop at rc 8): a sustained NaN from step 2 on — the
 # sentinel exits 8 ("diverged") and supervise.sh must NOT restart it.
 #
+# Phase 3 (pod, must converge to rc 0): TWO supervised hosts (one virtual
+# CPU device each, gloo standing in for DCN) and a peer_dead SIGKILL on
+# host 1 mid-epoch-1 — the scenario the reference can only hang on. Both
+# hosts must restart into the SAME generation, resume-consensus must
+# restore the identical verified checkpoint on both (digests agree, no
+# rc 9), and the run completes rc 0.
+#
+# Phase 4 (pod, must converge to rc 0): a corrupt LATEST checkpoint on the
+# shared out dir — host 0 alone quarantines it (exactly ONE *.corrupt
+# rename pod-wide) and both hosts fall back to the same older verified
+# checkpoint via the consensus broadcast.
+#
+# Phase 5 (pod, must stop at rc 8 on BOTH hosts): a sustained NaN gated to
+# host 1 only (CHAOS_HOST=1) — the sentinel's deterministic stop must
+# surface as the SAME rc 8 on the peer within one epoch boundary via the
+# fleet abort exchange: no indefinite hang, no spurious rc 7, no restart.
+#
 # CPU-only, synthetic data, tiny model: runs anywhere in a few minutes.
-# Usage: bash scripts/chaos_drill.sh [out_dir]
+# Select phases with CHAOS_PHASES (default "1 2 3 4 5"); the pod phases
+# skip gracefully when the platform cannot host two CPU processes (a
+# forced non-cpu JAX_PLATFORMS means only one host's worth of real
+# devices is available).
+# Usage: [CHAOS_PHASES="3 4 5"] bash scripts/chaos_drill.sh [out_dir]
 set -u
 REPO=$(cd "$(dirname "$0")/.." && pwd)
 OUT=${1:-"$REPO/runs/chaos_drill"}
-export JAX_PLATFORMS=cpu
+PHASES=${CHAOS_PHASES:-"1 2 3 4 5"}
+export JAX_PLATFORMS=${JAX_PLATFORMS:-cpu}
 
 COMMON=(baseline --dataset synthetic --platform cpu --model resnet18
         --variant cifar --dtype float32 --image_size 32 --num_classes 4
         --batchsize 64 --num_workers 1 --log_every 2 --epochs 3)
 
+# pod phases run TWO trainer processes on one machine (and restart them
+# repeatedly, each restart recompiling), so they take the lightest wire
+# that still trains: 16px, 64 samples, per-host batch 8 (global 16,
+# 4 steps/epoch) — the mechanisms under test are control-path, not
+# compute-path
+POD_COMMON=(baseline --dataset synthetic --synthetic_size 64 --platform cpu
+            --model resnet18 --variant cifar --dtype float32 --image_size 16
+            --num_classes 4 --batchsize 8 --num_workers 1 --log_every 2
+            --epochs 3)
+
 fail() { echo "CHAOS DRILL FAIL: $*" >&2; exit 1; }
+has_phase() { case " $PHASES " in *" $1 "*) return 0;; *) return 1;; esac; }
 
 # ---------------------------------------------------------------- phase 1 --
+if has_phase 1; then
 P1="$OUT/converge"
 rm -rf "$P1"; mkdir -p "$P1"
 SPEC1="nan_loss@step=2..3,loader_io@batch=5,ckpt_io@epoch=0,sigterm@step=12"
@@ -49,8 +84,10 @@ grep -q "action=restart" "$P1/restarts.log" \
 [ -f "$P1/ckpt_e2.msgpack" ] || fail "final epoch checkpoint missing"
 echo "[drill] phase 1 OK: converged to rc 0 through" \
      "$(grep -c 'action=restart' "$P1/restarts.log") restarts"
+fi
 
 # ---------------------------------------------------------------- phase 2 --
+if has_phase 2; then
 P2="$OUT/diverge"
 rm -rf "$P2"; mkdir -p "$P2"
 SPEC2="nan_loss@step=2.."
@@ -67,5 +104,156 @@ grep -q "action=restart" "$P2/restarts.log" 2>/dev/null \
   && fail "rc 8 was restarted — deterministic divergence must stop the chain"
 grep -q "rc=8" "$P2/restarts.log" || fail "rc=8 stop not logged"
 echo "[drill] phase 2 OK: sustained NaN stopped at rc 8 without a restart"
+fi
+
+# ------------------------------------------------------------- pod phases --
+pod_available() {
+  # the pod harness runs on virtual CPU devices; a forced non-cpu platform
+  # means only one host's worth of real devices is available — skip
+  [ "${JAX_PLATFORMS:-}" = "cpu" ]
+}
+
+free_port() {
+  python - <<'PY'
+import socket
+s = socket.socket()
+s.bind(("localhost", 0))
+print(s.getsockname()[1])
+PY
+}
+
+run_pod() { # $1=out $2=fault_spec [extra trainer flags...]; logs $out/host{0,1}.log
+  local out=$1 spec=$2; shift 2
+  local port i rc=0 r
+  port=$(free_port)
+  local pids=()
+  for i in 0 1; do
+    env XLA_FLAGS=--xla_force_host_platform_device_count=1 \
+        FLEET_COORDINATOR="localhost:$port" \
+        FLEET_NUM_PROCESSES=2 FLEET_PROCESS_ID=$i \
+        FLEET_RENDEZVOUS_ATTEMPTS=8 FLEET_RENDEZVOUS_BACKOFF_S=2 \
+        FLEET_RENDEZVOUS_BACKOFF_CAP_S=10 FLEET_RENDEZVOUS_TIMEOUT_S=60 \
+        FLEET_RENDEZVOUS_DEADLINE_S=300 \
+        CHAOS_HOST="${CHAOS_HOST:-}" \
+        MAX_RESTARTS=6 RUNTIME_BACKOFF_S=1 OUTAGE_BACKOFF_S=2 \
+      bash "$REPO/scripts/supervise.sh" "${POD_COMMON[@]}" \
+        --multihost --hang_timeout_s 120 \
+        --out "$out" --fault_spec "$spec" "$@" \
+        > "$out/host$i.log" 2>&1 &
+    pids[$i]=$!
+  done
+  for i in 0 1; do
+    wait "${pids[$i]}"; r=$?
+    [ "$r" -ne 0 ] && rc=$r
+  done
+  return "$rc"
+}
+
+last_generation() { # $1=log — generation of the last successful rendezvous
+  sed -n 's/.*rendezvous ok (generation=\([0-9]*\).*/\1/p' "$1" | tail -1
+}
+
+last_consensus_sha() { # $1=log — sha prefix of the last consensus resume
+  sed -n 's/.*consensus resume .*sha256=\([0-9a-f]*\).*/\1/p' "$1" | tail -1
+}
+
+# ---------------------------------------------------------------- phase 3 --
+if has_phase 3; then
+if ! pod_available; then
+  echo "[drill] phase 3 SKIPPED: JAX_PLATFORMS=${JAX_PLATFORMS:-} — only" \
+       "one host's worth of devices available (pod drill needs the CPU" \
+       "virtual-device harness)"
+else
+P3="$OUT/pod_peer_dead"
+rm -rf "$P3"; mkdir -p "$P3"
+SPEC3="peer_dead@step=6"  # 4 steps/epoch: dies in epoch 1, epoch-0 ckpt exists
+echo "[drill] phase 3: $SPEC3 on host 1 (CHAOS_HOST=1), two supervised hosts"
+CHAOS_HOST=1 run_pod "$P3" "$SPEC3"
+rc=$?
+[ "$rc" -eq 0 ] || fail "phase 3 exited rc=$rc, want 0 (see $P3/host*.log)"
+grep -q "chaos: host 1 dies (SIGKILL)" "$P3/host1.log" \
+  || fail "peer_dead never fired on host 1"
+grep -q "proc=0" "$P3/restarts.log" && grep -q "proc=1" "$P3/restarts.log" \
+  || fail "restarts.log lacks per-host attribution (proc= fields)"
+g0=$(last_generation "$P3/host0.log"); g1=$(last_generation "$P3/host1.log")
+[ -n "$g0" ] && [ "$g0" = "$g1" ] \
+  || fail "hosts restarted into different generations ('$g0' vs '$g1')"
+[ "$g0" -ge 1 ] || fail "no restart generation was ever recorded"
+s0=$(last_consensus_sha "$P3/host0.log"); s1=$(last_consensus_sha "$P3/host1.log")
+[ -n "$s0" ] && [ "$s0" = "$s1" ] \
+  || fail "consensus resume digests differ across hosts ('$s0' vs '$s1')"
+grep -q "rc=9" "$P3/restarts.log" \
+  && fail "pod went rc 9 (inconsistent resume) — consensus failed"
+[ -f "$P3/ckpt_e2.msgpack" ] || fail "final epoch checkpoint missing"
+echo "[drill] phase 3 OK: host-1 SIGKILL converged — generation $g0 on" \
+     "both hosts, identical consensus digest $s0"
+fi
+fi
+
+# ---------------------------------------------------------------- phase 4 --
+if has_phase 4; then
+if ! pod_available; then
+  echo "[drill] phase 4 SKIPPED: pod drill needs the CPU virtual-device harness"
+else
+P4="$OUT/pod_corrupt_ckpt"
+rm -rf "$P4"; mkdir -p "$P4"
+echo "[drill] phase 4: clean 2-host run, then a corrupt latest checkpoint" \
+     "on shared storage"
+run_pod "$P4" "" --epochs 2 \
+  || fail "phase 4 seed run failed (see $P4/host*.log)"
+[ -f "$P4/ckpt_e1.msgpack" ] || fail "seed run left no epoch-1 checkpoint"
+python - "$P4/ckpt_e1.msgpack" <<'PY'
+import sys
+path = sys.argv[1]
+data = open(path, "rb").read()
+open(path, "wb").write(data[: len(data) // 2])  # tear it; sidecar now disagrees
+PY
+mv "$P4/host0.log" "$P4/host0.seed.log"; mv "$P4/host1.log" "$P4/host1.seed.log"
+run_pod "$P4" "" \
+  || fail "phase 4 resume run failed (see $P4/host*.log)"
+n_corrupt=$(ls "$P4"/ckpt_e1.msgpack.corrupt 2>/dev/null | wc -l)
+[ "$n_corrupt" -eq 1 ] || fail "want exactly one quarantine rename, got $n_corrupt"
+grep -q "consensus resume ckpt_e0.msgpack" "$P4/host0.log" \
+  || fail "host 0 did not fall back to ckpt_e0 via consensus"
+grep -q "consensus resume ckpt_e0.msgpack" "$P4/host1.log" \
+  || fail "host 1 did not fall back to ckpt_e0 via consensus"
+s0=$(last_consensus_sha "$P4/host0.log"); s1=$(last_consensus_sha "$P4/host1.log")
+[ -n "$s0" ] && [ "$s0" = "$s1" ] \
+  || fail "fallback digests differ across hosts ('$s0' vs '$s1')"
+grep -q "rc=9" "$P4/restarts.log" 2>/dev/null \
+  && fail "pod went rc 9 on the fallback — consensus failed"
+[ -f "$P4/ckpt_e2.msgpack" ] || fail "resumed run never reached epoch 2"
+echo "[drill] phase 4 OK: both hosts fell back to ckpt_e0 (digest $s0)," \
+     "exactly one quarantine rename"
+fi
+fi
+
+# ---------------------------------------------------------------- phase 5 --
+if has_phase 5; then
+if ! pod_available; then
+  echo "[drill] phase 5 SKIPPED: pod drill needs the CPU virtual-device harness"
+else
+P5="$OUT/pod_abort"
+rm -rf "$P5"; mkdir -p "$P5"
+SPEC5="nan_loss@step=2.."
+echo "[drill] phase 5: $SPEC5 on host 1 only (CHAOS_HOST=1) — rc 8 must" \
+     "propagate to the peer within one epoch"
+CHAOS_HOST=1 run_pod "$P5" "$SPEC5" --max_bad_steps 3 --epochs 2
+rc=$?
+[ "$rc" -eq 8 ] || fail "phase 5 exited rc=$rc, want 8 (see $P5/host*.log)"
+grep -q "abort intent rc 8" "$P5/host1.log" \
+  || fail "host 1 never noted the sentinel abort intent"
+grep -q "pod abort rc 8 (from host 1)" "$P5/host0.log" \
+  || fail "host 0 never received the propagated rc 8"
+n_stops=$(grep -c "rc=8" "$P5/restarts.log")
+[ "$n_stops" -eq 2 ] || fail "want both supervisors to log the rc-8 stop, got $n_stops"
+grep -q "action=restart" "$P5/restarts.log" \
+  && fail "rc 8 was restarted — deterministic divergence must stop the pod"
+grep -q "rc=7" "$P5/restarts.log" \
+  && fail "spurious rc 7 — the abort exchange should beat the heartbeat"
+echo "[drill] phase 5 OK: one-host divergence stopped BOTH hosts at rc 8," \
+     "no hang, no rc 7, no restart"
+fi
+fi
 
 echo "CHAOS DRILL PASS"

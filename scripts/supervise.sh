@@ -6,9 +6,18 @@
 # restart command is identical to the start command because auto-resume picks
 # up the latest checkpoint in --out.
 #
-# Every non-zero exit is appended to $OUT/restarts.log (timestamp, rc,
-# backoff, attempt, action) when an --out dir is present in the args — the
-# post-mortem record of what the recovery chain actually did.
+# Every non-zero exit is appended to $OUT/restarts.log (timestamp, host,
+# process index, rc, backoff, attempt, action) when an --out dir is present
+# in the args — the post-mortem record of what the recovery chain actually
+# did. On pods every host's supervisor appends to the SAME shared log; the
+# host=/proc= fields keep the interleaved lines attributable.
+#
+# Pod runs additionally max-write the supervisor attempt number into the
+# shared $OUT/generation file before each restart: all hosts of a restart
+# wave converge on the same generation (two hosts observing G both write
+# G+1), and the trainer's rendezvous retry (parallel/fleet.py) logs/paces
+# against it so per-host backoff drift cannot make hosts miss each other's
+# rendezvous window.
 #
 # Usage: MAX_RESTARTS=5 bash scripts/supervise.sh <workload> --out runs/x [flags...]
 set -u
@@ -24,11 +33,28 @@ for a in "$@"; do
   prev="$a"
 done
 
+# process identity for shared (pod) restart logs: FLEET_PROCESS_ID is the
+# same env the trainer's rendezvous uses; single-host runs show proc=-
+host=$(hostname 2>/dev/null || echo "?")
+proc=${FLEET_PROCESS_ID:--}
+
 log_event() { # $1=rc $2=backoff $3=action
   [ -n "$out" ] || return 0
   mkdir -p "$out" 2>/dev/null || return 0
-  echo "$(date -Is) rc=$1 backoff=${2}s attempt=$n/$max action=$3" \
+  echo "$(date -Is) host=$host proc=$proc rc=$1 backoff=${2}s attempt=$n/$max action=$3" \
     >> "$out/restarts.log"
+}
+
+bump_generation() { # max-write our attempt number into $OUT/generation
+  [ -n "$out" ] || return 0
+  gf="$out/generation"
+  cur=$(cat "$gf" 2>/dev/null || echo 0)
+  case "$cur" in (''|*[!0-9]*) cur=0;; esac
+  if [ "$n" -gt "$cur" ]; then
+    tmp="$gf.tmp.$$"
+    echo "$n" > "$tmp" 2>/dev/null && mv "$tmp" "$gf" 2>/dev/null
+  fi
+  return 0
 }
 
 while true; do
@@ -46,9 +72,15 @@ while true; do
   # IO) — retryable, but with a backoff so a crash loop doesn't spin;
   # 3 is "backend unreachable" (trainer and bench share the code), where
   # an immediate restart just burns the probe budget — back off long
-  # enough for a tunnel blip to pass. Everything else (4 init watchdog,
-  # 7 mid-run hang, kill signals) restarts fast and auto-resumes from
-  # the newest checkpoint.
+  # enough for a tunnel blip to pass; 6 is "rendezvous failed"
+  # (parallel/fleet.py: jax.distributed.initialize never completed within
+  # its retry budget) — outage-shaped, the peers may simply not have
+  # restarted yet, so it takes the SAME long backoff as rc 3; 9 is
+  # "pod-inconsistent" (the resume digest agreement failed — usually
+  # shared-filesystem staleness) — retryable with the runtime backoff,
+  # the next consensus pass normally agrees. Everything else (4 init
+  # watchdog, 7 mid-run hang, kill signals) restarts fast and
+  # auto-resumes from the newest checkpoint.
   case "$rc" in
     2)
       echo "[supervise] rc=$rc is deterministic (config/usage error);" \
@@ -63,6 +95,8 @@ while true; do
       exit "$rc" ;;
     1) backoff=${RUNTIME_BACKOFF_S:-30} ;;
     3) backoff=${OUTAGE_BACKOFF_S:-300} ;;
+    6) backoff=${OUTAGE_BACKOFF_S:-300} ;;
+    9) backoff=${RUNTIME_BACKOFF_S:-30} ;;
     *) backoff=2 ;;
   esac
   n=$((n + 1))
@@ -74,5 +108,6 @@ while true; do
   echo "[supervise] trainer exited rc=$rc; restart $n/$max (auto-resume," \
        "${backoff}s backoff)" >&2
   log_event "$rc" "$backoff" restart
+  bump_generation
   sleep "$backoff"
 done

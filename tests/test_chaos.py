@@ -444,6 +444,7 @@ def test_full_chaos_drill(tmp_path):
     convergence to rc 0, then sustained NaN to rc 8 with no restart."""
     env = {k: v for k, v in os.environ.items()
            if k not in (chaoslib.ENV_SPEC, chaoslib.ENV_STATE_DIR)}
+    env["CHAOS_PHASES"] = "1 2"  # pod phases 3-5 are test_fleet's drill
     p = subprocess.run(
         ["bash", os.path.join(REPO, "scripts", "chaos_drill.sh"),
          str(tmp_path / "drill")],

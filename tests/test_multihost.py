@@ -3,11 +3,13 @@
 The reference's multi-node story is broken by construction (local rank used
 as global rank — SURVEY §2.2); this framework's `--multihost` path is
 `jax.distributed.initialize()` + per-host data sharding. Here we actually
-RUN it: two OS processes, 4 virtual CPU devices each, joined into one
-8-device platform (gloo standing in for DCN), driving the real mesh /
+RUN it: two OS processes, one virtual CPU device each, joined into one
+2-device platform (gloo standing in for DCN — see multihost_worker.py for
+why one device per process on this jaxlib), driving the real mesh /
 global-array / train-step path. The per-step losses must match a
 single-process 8-device run of the identical global batch — distribution
-must change where shards live, never the math.
+must change where shards live, never the math (the oracle and the workers
+deliberately run DIFFERENT topologies).
 """
 
 import json
@@ -28,7 +30,17 @@ def _free_port() -> int:
         return s.getsockname()[1]
 
 
+@pytest.mark.slow
 def test_two_process_run_matches_single_process():
+    """Fixed in the pod-fault-tolerance PR (three stacked root causes: the
+    multi-process CPU client had no cross-host collectives implementation;
+    jaxlib 0.4.37's gloo aborts on the concurrent collectives >1 local
+    device issues; the workers drew different init params than the
+    conftest-pinned oracle without jax_threefry_partitionable). It now
+    PASSES but costs ~6 min of wall clock — three full resnet18 compiles
+    in each of three processes — and the tier-1 suite is timeout-bound
+    (DOTS_PASSED at the cutoff is the budget), so it runs in the slow lane
+    next to the pod chaos drill that builds on it."""
     import jax
 
     from multihost_common import run_composed_steps, run_steps
@@ -70,9 +82,14 @@ def test_two_process_run_matches_single_process():
                 p.kill()
         if os.path.exists(out):
             os.remove(out)
-    np.testing.assert_allclose(losses, oracle, atol=1e-5)
-    # composed dp×tp (class-sharded partial-FC CE) across the process
-    # boundary: same math as the single-process 4×2 run
-    np.testing.assert_allclose(composed, oracle_composed, atol=1e-5)
+    # tolerance: the workers run 2 devices, the oracle 8 — partial sums
+    # reduce in a different order, and the f32 drift compounds per step
+    # (observed ~7e-5 by step 3); a real divergence (e.g. mismatched rng
+    # config) shows up as ~3e-1, three orders louder than this bound
+    np.testing.assert_allclose(losses, oracle, rtol=2e-4, atol=2e-4)
+    # composed dp×tp (class-sharded partial-FC CE) with the TP pair across
+    # the process boundary (1×2) vs the single-process 4×2 oracle: same
+    # math on a third topology
+    np.testing.assert_allclose(composed, oracle_composed, rtol=2e-4, atol=2e-4)
     # the parent's own backend must be unaffected
     assert jax.process_count() == 1

@@ -87,6 +87,30 @@ def test_serve_script_flags_match_cli():
     assert not unknown, f"serve.sh passes flags cli.serve rejects: {unknown}"
 
 
+def test_chaos_drill_flags_match_train_cli():
+    """chaos_drill.sh phases drive cli.train through supervise.sh: every
+    --flag it passes must exist in the train parser, and the pod phases'
+    load-bearing pieces (--multihost, peer_dead, CHAOS_HOST aiming, the
+    FLEET_ rendezvous knobs) must stay present — a silently dropped flag
+    would skip the pod drill without anyone noticing."""
+    from ddp_classification_pytorch_tpu.cli.train import build_parser
+
+    known = set()
+    for action in build_parser()._actions:
+        known.update(action.option_strings)
+    body = _script_body("chaos_drill.sh")
+    # XLA_FLAGS=--xla_... is an env assignment, not a CLI flag
+    cli_body = re.sub(r"XLA_FLAGS=\S+", "", body)
+    passed = set(re.findall(r"(?<![\w-])--[a-z_]+", cli_body))
+    unknown = sorted(passed - known)
+    assert not unknown, f"chaos_drill.sh passes flags cli.train rejects: {unknown}"
+    for needle in ("--multihost", "peer_dead@step=", "CHAOS_HOST=1",
+                   "FLEET_COORDINATOR=", "FLEET_PROCESS_ID=",
+                   "--hang_timeout_s", "nan_loss@step=",
+                   "ckpt_e1.msgpack.corrupt"):
+        assert needle in body, f"chaos_drill.sh lost its {needle!r} phase piece"
+
+
 def test_worklist_bench_step_captures_serve_row():
     """The owed-work list must keep running bench with BOTH evidence rows:
     --e2e (uint8 wire) and --serve (serve_latency) — a silently dropped
