@@ -403,11 +403,29 @@ def _bench_e2e_row(cfg, mesh, *, steps: int, warmup: int, metric: str,
             epoch += 1
 
     it = None
+    donation: dict = {}
     try:
         with mesh:
             model, tx, state = create_train_state(
                 cfg, mesh, steps_per_epoch=max(len(loader), 1))
             step = make_train_step(cfg, model, tx, mesh=mesh)
+            # donation/memory-analysis evidence (the ROADMAP's MFU item owes
+            # a donation audit so no step buffer round-trips HBM): AOT
+            # compile during the warmup window — the persistent cache makes
+            # it a cache hit on TPU — and read the executable's alias table
+            try:
+                from ddp_classification_pytorch_tpu.analysis.jaxpr_audit import (
+                    donation_evidence)
+
+                h = cfg.data.image_size
+                np_dt = np.uint8 if cfg.data.input_dtype == "uint8" else np.float32
+                donation = donation_evidence(step, (
+                    state,
+                    jax.ShapeDtypeStruct((batch, h, h, 3), np_dt),
+                    jax.ShapeDtypeStruct((batch,), np.int32)))
+            except Exception as e:  # evidence must never cost the row
+                print(f"# donation evidence failed: {type(e).__name__}: {e}",
+                      file=sys.stderr)
             it = batches()
             metrics = None
             for _ in range(max(warmup, 1)):  # >=1: compile outside the window
@@ -440,6 +458,13 @@ def _bench_e2e_row(cfg, mesh, *, steps: int, warmup: int, metric: str,
         "staged_batches": prefetcher.staged,
         "staged_off_thread": (prefetcher.stager_thread is not None
                               and prefetcher.stager_thread != main_ident),
+        # donation audit evidence (analysis/jaxpr_audit.donation_evidence):
+        # every donated state byte must be aliased in the executable, else
+        # that buffer round-trips HBM every step (coverage < 1.0 = finding)
+        "donated_bytes": donation.get("donated_bytes", 0),
+        "aliased_bytes": donation.get("aliased_bytes", 0),
+        "donation_coverage": donation.get("donation_coverage"),
+        "temp_bytes": donation.get("temp_bytes"),
     }
 
 

@@ -1,0 +1,53 @@
+"""Program-invariant analysis: the framework's conventions as machine checks.
+
+The repo has accumulated load-bearing invariants that nothing verified until
+now — every train step donates its state buffer (train/steps.py:_build_step),
+every step routes uint8 inputs through `device_input_epilogue`, hot-path
+programs carry no host callbacks, serve compiles exactly `len(buckets)`
+programs, and the CLIs map deterministic errors to the documented rc
+catalogue. Each was one careless PR away from silently regressing step time
+or pod determinism.
+
+This package turns them into three static/runtime passes over the *traced
+program* (jaxpr / compiled HLO), not just the source text:
+
+- `jaxpr_audit`  — a registry of every jitted step factory, lowered on
+  synthetic avals: donation actually aliases (per-buffer bytes), no
+  callback primitives in hot paths, uint8 avals reach the model only via
+  the `(x/255 − μ)/σ` epilogue, eval/serve jaxprs carry no collectives.
+- `lint`         — AST passes: host-sync idioms inside step factories
+  (`.item()`, `print`, `np.asarray`, `time.time()`, `float(tracer)`) and
+  CLI exit sites outside the documented rc catalogue.
+- `compile_sentinel` — a runtime recompile guard armed after warmup by the
+  trainer and the serving engine; any steady-state compile is counted and
+  logged with the offending signature (optionally fatal).
+
+Entry point: `python -m ddp_classification_pytorch_tpu.cli.analyze`
+(rc 0 clean / rc 1 findings / rc 2 usage — same discipline as train/serve);
+`scripts/lint.sh` is the CI wrapper. Runbook: docs/analysis.md.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Dict
+
+
+@dataclass
+class Finding:
+    """One invariant violation. `check` names the detector (donation,
+    callback, collectives, uint8-epilogue, host-sync, rc-catalogue,
+    recompile), `where` locates it (registry entry or file:line), and
+    `evidence` carries the machine-readable payload (byte counts, primitive
+    names, signatures) the CLI prints and tests assert on."""
+
+    check: str
+    where: str
+    message: str
+    evidence: Dict[str, Any] = field(default_factory=dict)
+
+    def __str__(self) -> str:  # the CLI's one-line rendering
+        return f"[{self.check}] {self.where}: {self.message}"
+
+
+__all__ = ["Finding"]

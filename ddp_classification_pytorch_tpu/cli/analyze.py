@@ -1,0 +1,143 @@
+"""`analyze` entrypoint — run the program-invariant analyzer over the repo.
+
+    python -m ddp_classification_pytorch_tpu.cli.analyze            # all passes
+    python -m ddp_classification_pytorch_tpu.cli.analyze --passes lint
+    python -m ddp_classification_pytorch_tpu.cli.analyze --list     # inventory
+
+Exit discipline (same taxonomy as cli.train / cli.serve, docs/operations.md):
+
+- **rc 0** — every invariant holds (donation aliasing, callback-free hot
+  paths, uint8 epilogue, collective-free eval/serve programs, host-sync-free
+  step factories, catalogued CLI exit codes);
+- **rc 1** — findings: each printed as `[check] where: message`, machine
+  copies via `--json`;
+- **rc 2** — usage/config error (unknown pass name, argparse errors).
+
+The jaxpr pass lowers real step factories on a tiny synthetic config, so it
+runs in seconds on CPU; analysis never needs (or touches) an accelerator —
+the backend is pinned to CPU unless `--platform` overrides it. CI wrapper:
+`scripts/lint.sh`; runbook for a red finding: docs/analysis.md.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import Optional, Sequence
+
+PASSES = ("jaxpr", "lint")
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="ddp_classification_pytorch_tpu.cli.analyze",
+        description="program-invariant analyzer: jaxpr/HLO donation audit, "
+                    "host-sync & rc-catalogue linting",
+    )
+    p.add_argument("--passes", default=",".join(PASSES),
+                   help="comma list of passes to run: jaxpr (trace/compile "
+                        "the step registry) and/or lint (AST passes); "
+                        "default: all")
+    p.add_argument("--arch", default="resnet18",
+                   help="backbone for the audit's tiny traced config "
+                        "(invariants are program-structure properties, "
+                        "independent of scale)")
+    p.add_argument("--image_size", type=int, default=32)
+    p.add_argument("--num_classes", type=int, default=8)
+    p.add_argument("--batchsize", "-b", type=int, default=8,
+                   help="synthetic batch aval (must divide the device count "
+                        "for the shard_map entry)")
+    p.add_argument("--json", default="",
+                   help="also write findings + registry evidence as JSON")
+    p.add_argument("--list", action="store_true",
+                   help="print the registry + invariant inventory and exit 0")
+    p.add_argument("--rc-paths", nargs="*", default=None,
+                   help="explicit files for the rc-catalogue lint "
+                        "(default: the cli/ package)")
+    p.add_argument("--platform", default="", choices=["", "cpu", "tpu"],
+                   help="JAX platform for the jaxpr pass (default cpu: "
+                        "analysis must never burn — or hang on — an "
+                        "accelerator lease)")
+    return p
+
+
+def main(argv: Optional[Sequence[str]] = None) -> None:
+    args = build_parser().parse_args(argv)
+    passes = tuple(s.strip() for s in args.passes.split(",") if s.strip())
+    unknown = [s for s in passes if s not in PASSES]
+    if unknown or not passes:
+        # deterministic config error → rc 2, the code supervisors never retry
+        print(f"[analyze] config error: unknown pass(es) {unknown or passes}; "
+              f"choose from {list(PASSES)}", file=sys.stderr)
+        raise SystemExit(2)
+
+    from ..analysis.jaxpr_audit import build_registry
+
+    if args.list:
+        print("registered step programs (jaxpr pass):")
+        for spec in build_registry():
+            props = []
+            if spec.donate:
+                props.append(f"donates args {list(spec.donate)} (must alias)")
+            else:
+                props.append("no-donate (documented)")
+            if spec.hot_path:
+                props.append("callback-free")
+            if not spec.allow_collectives:
+                props.append("collective-free")
+            if spec.uint8_input:
+                props.append("uint8→epilogue")
+            print(f"  {spec.name:22s} {spec.factory}")
+            print(f"  {'':22s} invariants: {', '.join(props)}")
+        print("lint pass: host-sync idioms in the factories above; "
+              "rc catalogue over cli/ exits (docs/operations.md matrix)")
+        return
+
+    findings = []
+    evidence = {}
+
+    if "lint" in passes:
+        from ..analysis.lint import lint_rc_sites, lint_step_factories
+
+        findings += lint_step_factories()
+        findings += lint_rc_sites(paths=args.rc_paths)
+
+    if "jaxpr" in passes:
+        import jax
+
+        # analysis is host-side program inspection: pin CPU so a wedged TPU
+        # tunnel can never hang the linter (cf. backend probing in cli.train)
+        jax.config.update("jax_platforms", args.platform or "cpu")
+        from ..analysis.jaxpr_audit import AuditContext, audit_registry
+
+        ctx = AuditContext(arch=args.arch, image_size=args.image_size,
+                           num_classes=args.num_classes, batch=args.batchsize)
+        jx_findings, specs = audit_registry(ctx)
+        findings += jx_findings
+        for spec in specs:
+            evidence[spec.name] = spec.evidence
+            don = spec.evidence.get("donation")
+            if don:
+                print(f"[analyze] {spec.name}: donated={don['donated_bytes']}B "
+                      f"aliased={don['aliased_bytes']}B "
+                      f"coverage={don['donation_coverage']}")
+
+    if args.json:
+        with open(args.json, "w") as f:
+            json.dump({"findings": [vars(fd) for fd in findings],
+                       "evidence": evidence}, f, indent=2, default=str)
+
+    for fd in findings:
+        print(str(fd), file=sys.stderr)
+    if findings:
+        print(f"[analyze] {len(findings)} finding(s) — see docs/analysis.md "
+              "for the runbook", file=sys.stderr)
+        raise SystemExit(1)
+    ran = "+".join(passes)
+    print(f"[analyze] clean: {ran} pass(es), "
+          f"{len(evidence) or 'no'} programs audited")
+
+
+if __name__ == "__main__":
+    main()

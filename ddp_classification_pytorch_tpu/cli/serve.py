@@ -85,6 +85,11 @@ def build_parser() -> argparse.ArgumentParser:
     s.add_argument("--selfcheck", type=int, default=0,
                    help="serve N synthetic requests through the full engine "
                         "path, print metrics, drain, exit 0 (smoke mode)")
+    s.add_argument("--strict_compile", action="store_true",
+                   help="make a steady-state recompile fatal (rc 2): warmup "
+                        "prepays exactly len(buckets) programs and arms a "
+                        "compile sentinel; default logs + counts it in "
+                        "metrics (analysis/compile_sentinel.py)")
 
     r = p.add_argument_group("run")
     r.add_argument("--out", default="", help="metrics/records output dir")
@@ -140,6 +145,8 @@ def config_from_args(args: argparse.Namespace) -> Config:
         sv.port = args.port
     if args.log_every_s >= 0:
         sv.log_every_s = args.log_every_s
+    if args.strict_compile:
+        sv.strict_compile = True
 
     sv.resolve_buckets()  # raises ValueError on bad knob combinations
     if sv.topk > cfg.data.num_classes:
@@ -292,6 +299,13 @@ def main(argv: Optional[Sequence[str]] = None) -> None:
         if tb is not None:
             metrics.to_tensorboard(tb, 0)
             tb.close()
+        if engine.fatal_error is not None:
+            import sys
+
+            # strict_compile tripped: deterministic (the same traffic
+            # replays the same cache miss) → rc 2, do not restart
+            print(f"[serve] {engine.fatal_error}", file=sys.stderr)
+            raise SystemExit(2)
         host0_print(f"[serve] selfcheck ok: {args.selfcheck} requests, "
                     f"buckets used {sorted(engine.seen_buckets)}")
         return
@@ -311,6 +325,8 @@ def main(argv: Optional[Sequence[str]] = None) -> None:
 
     step = 0
     while not stop.wait(cfg.serve.log_every_s):
+        if engine.fatal_error is not None:
+            break  # strict_compile tripped: intake already stopped
         host0_print(metrics.log_line(engine.queue_depth))
         if tb is not None:
             metrics.to_tensorboard(tb, step)
@@ -330,6 +346,11 @@ def main(argv: Optional[Sequence[str]] = None) -> None:
     if tb is not None:
         metrics.to_tensorboard(tb, step)
         tb.close()
+    if engine.fatal_error is not None:
+        import sys
+
+        print(f"[serve] {engine.fatal_error}", file=sys.stderr)
+        raise SystemExit(2)
     host0_print("[serve] drained clean")
 
 

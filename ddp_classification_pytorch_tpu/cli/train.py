@@ -195,6 +195,12 @@ def build_parser() -> argparse.ArgumentParser:
                         "('diverged' — deterministic, supervise.sh does "
                         "not restart it). Default 25; 0 = skip forever, "
                         "never exit")
+    r.add_argument("--strict_compile", action="store_true",
+                   help="make a steady-state recompile fatal (rc 2 at the "
+                        "epoch boundary): after the first eval'd epoch a "
+                        "compile sentinel treats any further XLA compile as "
+                        "a signature drift; default logs it warn-only "
+                        "(analysis/compile_sentinel.py)")
     r.add_argument("--fault_spec", default="",
                    help="deterministic fault injection (utils/chaos.py), "
                         "e.g. 'nan_loss@step=7..9,ckpt_io@epoch=1,"
@@ -378,6 +384,8 @@ def config_from_args(args: argparse.Namespace) -> Config:
         cfg.run.hang_timeout_s = args.hang_timeout_s
     if args.max_bad_steps >= 0:
         cfg.run.max_bad_steps = args.max_bad_steps
+    if args.strict_compile:
+        cfg.run.strict_compile = True
     if args.fault_spec:
         cfg.run.fault_spec = args.fault_spec
     if args.grad_accum:
@@ -542,10 +550,20 @@ def main(argv: Optional[Sequence[str]] = None) -> None:
         traceback.print_exc(file=sys.stderr)
         print(f"[trainer] config error: {e}", file=sys.stderr)
         raise SystemExit(2) from None
+    from ..analysis.compile_sentinel import SteadyStateRecompile
     from ..train.sentinel import SentinelDiverged
 
     try:
         trainer.run()
+    except SteadyStateRecompile as e:
+        import sys
+
+        # --strict_compile tripped: a steady-state XLA compile means some
+        # aval/signature drifted mid-run — deterministic (the same run
+        # replays the same cache miss), so rc 2: supervisors must not
+        # restart it. The sentinel already logged the offending signature.
+        print(f"[trainer] steady-state recompile: {e}", file=sys.stderr)
+        raise SystemExit(SteadyStateRecompile.exit_code) from None
     except SentinelDiverged as e:
         import sys
 

@@ -278,6 +278,13 @@ class RunConfig:
     # CHAOS_FAULT_SPEC env overrides; empty = every hook is inert and the
     # train step compiles to exactly the uninjected program.
     fault_spec: str = ""
+    # Compile sentinel (analysis/compile_sentinel.py): the trainer arms a
+    # recompile guard once the first eval'd epoch completes (all steady-state
+    # programs compiled); any later compile is logged with the offending
+    # function + aval signature. False = warn-only; True = deterministic
+    # rc 2 at the epoch boundary (a steady-state recompile replays on
+    # restart, so supervisors must not retry it).
+    strict_compile: bool = False
 
 
 @dataclass
@@ -307,6 +314,11 @@ class ServeConfig:
     reload_poll_s: float = 5.0  # hot-reload poll cadence
     port: int = 0  # >0: stdlib http front-end on this port (serve/http.py)
     log_every_s: float = 10.0  # metrics console line cadence
+    # Compile sentinel: warmup() arms a recompile guard after prepaying the
+    # bucket programs; a steady-state compile (a shape leaking past the
+    # bucket padding) is counted + logged. False = warn-only; True = the
+    # engine stops intake and cli.serve exits rc 2 (deterministic).
+    strict_compile: bool = False
 
     def resolve_buckets(self) -> tuple:
         """Validated ascending bucket tuple (ValueError = config-shaped,

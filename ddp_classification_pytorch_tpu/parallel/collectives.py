@@ -98,4 +98,7 @@ def make_shard_map_train_step(
     sharded = shard_map_unchecked(
         per_shard, mesh=mesh, in_specs=(P(), P(DATA_AXIS), P(DATA_AXIS)),
         out_specs=(P(), P()))
+    # donate_argnums=0 is audited (analysis/jaxpr_audit.py): every state
+    # byte must alias in the executable — this entry also opts INTO the
+    # collectives check exemption, since explicit psum/pmean IS its point
     return jax.jit(sharded, donate_argnums=0)

@@ -93,6 +93,7 @@ class ServingEngine:
         buckets: Sequence[int] = (1, 2, 4, 8),
         metrics: Optional[Any] = None,
         transform: Optional[Any] = None,
+        strict_compile: bool = False,
     ):
         buckets = tuple(sorted(set(int(b) for b in buckets)))
         if not buckets or buckets[0] < 1:
@@ -123,6 +124,13 @@ class ServingEngine:
         # evidence for the compile-count bound: which padded shapes actually
         # ran (tests assert seen_buckets ⊆ buckets and the jit cache size)
         self.seen_buckets: set = set()
+        # recompile guard (analysis/compile_sentinel.py): warmup() arms it
+        # after prepaying the bucket programs; any steady-state compile is
+        # counted + logged, and with strict_compile the engine stops intake
+        # and surfaces SteadyStateRecompile via `fatal_error`
+        self.strict_compile = bool(strict_compile)
+        self.compile_sentinel: Optional[Any] = None
+        self.fatal_error: Optional[BaseException] = None
 
     @classmethod
     def from_config(cls, cfg, state, predict, metrics=None, transform=None):
@@ -136,6 +144,7 @@ class ServingEngine:
             queue_depth=cfg.serve.queue_depth,
             buckets=cfg.serve.resolve_buckets(),
             metrics=metrics, transform=transform,
+            strict_compile=cfg.serve.strict_compile,
         )
 
     # -------------------------------------------------------------- intake --
@@ -249,6 +258,26 @@ class ServingEngine:
             lats.append(lat_ms)
             r.future.set_result(Prediction(indices[i], scores[i], lat_ms))
         self.metrics.record_batch(bucket, n, lats)
+        self._check_compile_sentinel()
+
+    def _check_compile_sentinel(self) -> None:
+        """Batch-boundary recompile check (requests already answered). A
+        steady-state compile is counted + logged; under strict_compile the
+        engine stops intake and raises — the batcher thread converts that
+        into `fatal_error` for cli.serve to classify (rc 2)."""
+        if self.compile_sentinel is None:
+            return
+        from ..analysis.compile_sentinel import SteadyStateRecompile
+
+        try:
+            events = self.compile_sentinel.check(strict=self.strict_compile)
+        except SteadyStateRecompile as e:
+            self.metrics.record_recompile(self.compile_sentinel.violations)
+            self.fatal_error = e
+            self._closed = True  # stop intake; queued work still flushes
+            raise
+        if events:
+            self.metrics.record_recompile(len(events))
 
     def process_once(self, timeout_s: float = 0.0) -> int:
         """Collect and run ONE micro-batch inline; returns requests served
@@ -262,12 +291,38 @@ class ServingEngine:
 
     def warmup(self) -> None:
         """Compile every bucket up front (zero batches, results discarded)
-        so the first real request never pays a compile."""
+        so the first real request never pays a compile — and PROVE the
+        bounded-compile claim: on a cold predict exactly `len(buckets)`
+        predict programs must compile here (a warm/shared predict may
+        compile fewer, never more). The sentinel stays armed afterwards, so
+        any steady-state compile (a shape leaking past the bucket padding)
+        is caught at the batch boundary."""
+        from ..analysis.compile_sentinel import CompileSentinel
+
+        pre = self.compiled_programs()
+        sentinel = CompileSentinel(tag="serve")
+        sentinel.arm()
         h = self.image_size
         for b in self.buckets:
             scores, _ = self._predict(
                 self._state, np.zeros((b, h, h, 3), self._np_dtype))
             np.asarray(scores)  # block: compile belongs to warmup, not a request
+        events = sentinel.take()
+        pname = getattr(self._predict, "__name__", "")
+        n_new = (len([e for e in events if e.name == pname]) if pname
+                 else len(events))
+        if pre == 0 and n_new != len(self.buckets):
+            raise RuntimeError(
+                f"serve warmup compiled {n_new} predict programs, expected "
+                f"exactly {len(self.buckets)} (one per bucket "
+                f"{list(self.buckets)}) — the bucket→compile contract is "
+                "broken (docs/serving.md)")
+        if n_new > len(self.buckets):
+            raise RuntimeError(
+                f"serve warmup compiled {n_new} predict programs for "
+                f"{len(self.buckets)} buckets — more shapes than the bucket "
+                "set admits")
+        self.compile_sentinel = sentinel  # armed: steady state begins
 
     def compiled_programs(self) -> Optional[int]:
         """jit cache size of the predict fn when the runtime exposes it —
@@ -286,8 +341,15 @@ class ServingEngine:
             raise EngineClosed("cannot start a drained engine")
 
         def loop():
+            from ..analysis.compile_sentinel import SteadyStateRecompile
+
             while not self._stop.is_set():
-                self.process_once(timeout_s=0.05)
+                try:
+                    self.process_once(timeout_s=0.05)
+                except SteadyStateRecompile:
+                    # fatal_error is set and intake stopped; keep flushing
+                    # the already-accepted queue so drain stays graceful
+                    continue
 
         self._thread = threading.Thread(target=loop, daemon=True,
                                         name="serve-batcher")
@@ -307,9 +369,19 @@ class ServingEngine:
             self._thread.join(timeout=max(deadline - time.monotonic(), 0.1))
             self._thread = None
         # anything left (thread raced its stop flag, or engine never started)
-        # flushes inline — same process_once the thread ran
-        while self.process_once(timeout_s=0.0):
-            pass
+        # flushes inline — same process_once the thread ran. A strict-mode
+        # recompile during the flush must not break the rc-0 drain contract:
+        # fatal_error is already recorded, the queued requests still answer.
+        from ..analysis.compile_sentinel import SteadyStateRecompile
+
+        while True:
+            try:
+                if not self.process_once(timeout_s=0.0):
+                    break
+            except SteadyStateRecompile:
+                continue
+        if self.compile_sentinel is not None:
+            self.compile_sentinel.disarm()
 
     def close(self) -> None:
         """Abort: stop the batcher and fail whatever is still queued
@@ -327,3 +399,5 @@ class ServingEngine:
                 break
             if not req.future.done():
                 req.future.set_exception(EngineClosed("engine closed"))
+        if self.compile_sentinel is not None:
+            self.compile_sentinel.disarm()

@@ -46,6 +46,7 @@ class ServeMetrics:
         self.errors = 0  # predict failures (futures carry the exception)
         self.reloads = 0  # successful hot-reload swaps
         self.reloads_rejected = 0  # corrupt candidates quarantined
+        self.recompiles = 0  # steady-state compiles the sentinel caught
         self.rows_real = 0
         self.rows_padded = 0
         self.bucket_hist: Dict[int, int] = {}  # bucket size -> batches run
@@ -85,6 +86,12 @@ class ServeMetrics:
             else:
                 self.reloads_rejected += 1
 
+    def record_recompile(self, n: int = 1) -> None:
+        """Steady-state compile(s) observed by the engine's sentinel — each
+        one stalled a micro-batch for a full XLA compile."""
+        with self._lock:
+            self.recompiles += n
+
     # ----------------------------------------------------------- snapshot --
     def snapshot(self, queue_depth: Optional[int] = None) -> Dict:
         with self._lock:
@@ -98,6 +105,7 @@ class ServeMetrics:
                 "errors": self.errors,
                 "reloads": self.reloads,
                 "reloads_rejected": self.reloads_rejected,
+                "recompiles": self.recompiles,
                 "bucket_hist": dict(self.bucket_hist),
                 "fill_ratio": round(
                     self.rows_real / max(self.rows_real + self.rows_padded, 1), 4),

@@ -40,6 +40,7 @@ from typing import Any, Dict, Optional, Tuple
 import jax
 import numpy as np
 
+from ..analysis.compile_sentinel import CompileSentinel
 from ..config import Config
 from ..data.device_prefetch import DevicePrefetcher
 from ..data.loader import ShardedLoader
@@ -194,6 +195,13 @@ class Trainer:
         # non-finite step policy: skip counting + rc-8 escalation
         # (train/sentinel.py); the streak carries across epochs
         self.sentinel = StepSentinel(cfg.run.max_bad_steps)
+        # recompile guard (analysis/compile_sentinel.py): armed by run()
+        # once the first eval'd epoch completes — by then every steady-state
+        # program (train step, eval step, checkpoint gather) has compiled,
+        # so any later compile is a signature drift worth flagging
+        self.compile_sentinel = CompileSentinel(
+            tag=f"trainer[{cfg.workload}]", log=host0_print)
+        self._compile_sentinel_ready = False
         if train_ds is None:
             train_ds, val_ds = build_datasets(cfg)
         self.train_ds, self.val_ds = train_ds, val_ds
@@ -402,6 +410,11 @@ class Trainer:
                     # _sentinel_flush).
                     self._sentinel_flush()
                     self._heartbeat.touch()
+                    if self.compile_sentinel.armed:
+                        # mid-epoch recompile detection at the same cadence;
+                        # warn-only here — strict enforcement waits for the
+                        # epoch boundary so a pod never aborts mid-collective
+                        self.compile_sentinel.check(strict=False)
         finally:
             # a mid-epoch exception (divergence, injected fault, loader IO)
             # must stop and join the stager thread — a leaked stager would
@@ -483,6 +496,19 @@ class Trainer:
             host0_print("[initial eval] " +
                         " ".join(f"{k}={v:.4f}" for k, v in init_m.items()))
         for epoch in range(self.start_epoch, cfg.run.epochs):
+            if self.compile_sentinel.armed:
+                # epoch-boundary enforcement point: every host compiles the
+                # same programs deterministically, so a strict raise here
+                # lands on every pod member together (same rc 2)
+                self.compile_sentinel.check(strict=cfg.run.strict_compile)
+            elif self._compile_sentinel_ready:
+                # one full epoch cycle (train + eval + save) has completed —
+                # arming any earlier would flag the eval/gather first
+                # compiles; arming a cycle later (not at save time) keeps
+                # the async checkpoint's background compile out of scope
+                self.compile_sentinel.arm()
+                host0_print("[compile-sentinel] armed: steady state begins "
+                            f"(strict={cfg.run.strict_compile})")
             t0 = time.time()
             train_m = self.train_epoch(epoch, eta)
             if self.fleet is not None:
@@ -510,12 +536,18 @@ class Trainer:
             metric = val_m.get("val_top1")
             self.ckpt.save(self.state, epoch, metric=metric,
                            **({"best_k": val_m["best_k"]} if "best_k" in val_m else {}))
+            if val_m:
+                self._compile_sentinel_ready = True  # arm at next epoch top
         # the drain below can block on device_gets for an in-flight async
         # save — that is backend work, so it stays under the heartbeat
         # (writes are atomic, so a fire mid-drain cannot truncate; the
         # supervisor's restart then auto-resumes into an already-complete
         # run and exits cleanly)
         self._heartbeat.touch()
+        if self.compile_sentinel.armed:
+            # surface the last epoch's recompiles, then release the logger
+            self.compile_sentinel.check(strict=cfg.run.strict_compile)
+            self.compile_sentinel.disarm()
         self.ckpt.wait()  # land any in-flight async checkpoint before returning
         self._heartbeat.stop()
         if self.tb is not None:

@@ -1,0 +1,19 @@
+#!/usr/bin/env bash
+# Program-invariant analyzer over the repo itself — the CI gate.
+#
+# Runs every pass of cli.analyze (jaxpr/HLO donation audit + host-sync and
+# rc-catalogue lint) on CPU and exits with its code: 0 clean, 1 findings
+# (each printed as `[check] where: message`; runbook docs/analysis.md),
+# 2 usage error. Extra flags pass through, e.g.:
+#
+#   bash scripts/lint.sh                      # all passes
+#   bash scripts/lint.sh --passes lint        # AST passes only (fast)
+#   bash scripts/lint.sh --json /tmp/a.json   # machine copy of findings
+#
+# Flags used here are locked against the cli.analyze parser by
+# tests/test_scripts_meta.py.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+JAX_PLATFORMS=cpu exec python -m ddp_classification_pytorch_tpu.cli.analyze \
+    --passes jaxpr,lint "$@"

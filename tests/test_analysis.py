@@ -1,0 +1,375 @@
+"""Program-invariant analyzer (analysis/, cli.analyze).
+
+Two halves, per the acceptance contract:
+
+1. **Every detector must trip on a known-bad sample** — an undonated dead
+   arg, a host callback inside jit, a uint8 input bypassing the normalize
+   epilogue, a collective in a host-local program, host-sync idioms in a
+   step factory, an uncatalogued CLI exit code, a steady-state recompile.
+   The fixtures are 3-line jits/sources, so each proof costs milliseconds.
+
+2. **The real repo passes** — ONE module-scoped run of the full registry
+   audit (the only expensive trace/compile in this file; tier-1 budget),
+   asserted clean, with the train steps' donation coverage at exactly 1.0
+   (the before/after aliased-bytes evidence the MFU item owes).
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from ddp_classification_pytorch_tpu.analysis import Finding
+from ddp_classification_pytorch_tpu.analysis.compile_sentinel import (
+    CompileSentinel,
+    SteadyStateRecompile,
+)
+from ddp_classification_pytorch_tpu.analysis.jaxpr_audit import (
+    AuditContext,
+    StepSpec,
+    audit_donation,
+    audit_entry,
+    audit_registry,
+    build_registry,
+    donation_evidence,
+)
+from ddp_classification_pytorch_tpu.analysis.lint import (
+    lint_factory_source,
+    lint_rc_sites,
+    lint_rc_source,
+    lint_step_factories,
+)
+
+# --------------------------------------------------------------- fixtures --
+
+
+@pytest.fixture(scope="module")
+def audit():
+    """The one expensive piece: the full registry audit (state inits, six
+    jaxpr traces, two donated-step compiles) — shared by every
+    real-repo assertion below."""
+    from types import SimpleNamespace
+
+    ctx = AuditContext()
+    findings, specs = audit_registry(ctx)
+    return SimpleNamespace(ctx=ctx, findings=findings,
+                           specs={s.name: s for s in specs})
+
+
+def _fixture_spec(fn, args, **kw):
+    return StepSpec(name="fixture", factory="tests:fixture",
+                    build=lambda ctx: (fn, args), **kw)
+
+
+# ------------------------------------------------- detectors must trip --
+
+
+def test_donation_detector_fires_on_unaliased_donated_arg(audit):
+    """A donated buffer with no same-shape output cannot alias — the audit
+    must report the gap with byte counts, not stay silent."""
+    fn = jax.jit(lambda s: s[:2].sum(), donate_argnums=0)
+    findings, ev = audit_donation(fn, (jnp.zeros((8, 8), jnp.float32),),
+                                  "fixture")
+    assert findings and findings[0].check == "donation"
+    assert ev["donated_bytes"] == 8 * 8 * 4
+    assert ev["aliased_bytes"] < ev["donated_bytes"]
+    assert "bytes" in findings[0].message
+
+
+def test_donation_detector_fires_on_missing_donation(audit):
+    """A registry entry that PROMISES donation must fail when the factory
+    jits without donate_argnums (the exact regression the ROADMAP's MFU
+    item guards against)."""
+    fn = jax.jit(lambda s, x: (s + x.sum(), x * 2))  # state NOT donated
+    spec = _fixture_spec(fn, (jnp.zeros((16, 16), jnp.float32),
+                              jax.ShapeDtypeStruct((4,), jnp.float32)),
+                         donate=(0,))
+    findings = audit_entry(spec, audit.ctx)
+    assert any(f.check == "donation" for f in findings)
+
+
+def test_callback_detector_fires_on_debug_print(audit):
+    def bad(x):
+        jax.debug.print("x = {x}", x=x)
+        return x * 2
+
+    spec = _fixture_spec(jax.jit(bad),
+                         (jax.ShapeDtypeStruct((4,), jnp.float32),),
+                         no_donate_reason="fixture")
+    findings = audit_entry(spec, audit.ctx)
+    assert any(f.check == "callback" for f in findings)
+    assert any("debug_callback" in str(f.evidence) for f in findings)
+
+
+def test_collective_detector_fires_and_allowlist_clears(audit):
+    from jax.sharding import PartitionSpec as P
+
+    from ddp_classification_pytorch_tpu.utils.compat import shard_map_unchecked
+
+    mesh = audit.ctx.mesh
+    fn = jax.jit(shard_map_unchecked(
+        lambda x: jax.lax.psum(x, "data"), mesh=mesh,
+        in_specs=P("data"), out_specs=P()))
+    args = (jax.ShapeDtypeStruct((8,), jnp.float32),)
+    hit = audit_entry(_fixture_spec(fn, args, no_donate_reason="fixture"),
+                      audit.ctx)
+    assert any(f.check == "collectives" and "psum" in f.message for f in hit)
+    clean = audit_entry(_fixture_spec(fn, args, no_donate_reason="fixture",
+                                      allow_collectives=True), audit.ctx)
+    assert not [f for f in clean if f.check == "collectives"]
+
+
+def test_uint8_detector_fires_on_epilogue_bypass(audit):
+    """Raw pixels converted to float WITHOUT the /255 normalize = the uint8
+    dataplane contract broken (PR 3's NOTE: every new step must call
+    device_input_epilogue)."""
+    fn = jax.jit(lambda x: x.astype(jnp.float32).sum())
+    spec = _fixture_spec(fn, (jax.ShapeDtypeStruct((4, 8, 8, 3), jnp.uint8),),
+                         no_donate_reason="fixture", uint8_input=True)
+    findings = audit_entry(spec, audit.ctx)
+    assert any(f.check == "uint8-epilogue" for f in findings)
+
+
+def test_uint8_detector_fires_on_direct_consumption(audit):
+    """uint8 fed straight into arithmetic (no convert at all) must flag."""
+    fn = jax.jit(lambda x: (x * 2).sum())
+    spec = _fixture_spec(fn, (jax.ShapeDtypeStruct((4, 8, 8, 3), jnp.uint8),),
+                         no_donate_reason="fixture", uint8_input=True)
+    findings = audit_entry(spec, audit.ctx)
+    assert any(f.check == "uint8-epilogue" for f in findings)
+
+
+def test_uint8_detector_passes_the_real_epilogue(audit):
+    from ddp_classification_pytorch_tpu.train.steps import device_input_epilogue
+
+    fn = jax.jit(lambda x: device_input_epilogue(x).sum())
+    spec = _fixture_spec(fn, (jax.ShapeDtypeStruct((4, 8, 8, 3), jnp.uint8),),
+                         no_donate_reason="fixture", uint8_input=True)
+    findings = audit_entry(spec, audit.ctx)
+    assert not [f for f in findings if f.check == "uint8-epilogue"]
+
+
+_BAD_FACTORY = '''
+import time
+import numpy as np
+
+def make_bad_step(model):
+    def step(state, images):
+        t0 = time.time()
+        print("loss so far")
+        host = np.asarray(images)
+        return float(state.loss) + state.loss.item() + host.mean() + t0
+    return step
+'''
+
+
+def test_host_sync_lint_fires_on_every_idiom():
+    findings = lint_factory_source(_BAD_FACTORY, function="make_bad_step")
+    msgs = " | ".join(f.message for f in findings)
+    for idiom in (".item()", "print", "np.asarray", "time.time", "float()"):
+        assert idiom in msgs, (idiom, msgs)
+    assert len(findings) == 5
+
+
+def test_host_sync_lint_flags_stale_provenance():
+    findings = lint_factory_source("x = 1\n", function="make_missing")
+    assert findings and "not found" in findings[0].message
+
+
+def test_rc_lint_fires_on_uncatalogued_codes():
+    assert lint_rc_source("import sys\nsys.exit(13)\n")
+    assert lint_rc_source("raise SystemExit(99)\n")
+    assert lint_rc_source("import sys\nsys.exit(compute_rc())\n")
+
+
+def test_rc_lint_passes_catalogued_patterns():
+    src = (
+        "import sys\n"
+        "sys.exit(2)\n"
+        "raise SystemExit(0 if ok else 1)\n"
+        "raise SystemExit(SentinelDiverged.exit_code)\n"
+        "raise SystemExit(e.code)\n"
+    )
+    assert lint_rc_source(src) == []
+
+
+# --------------------------------------------------- the real repo passes --
+
+
+def test_registry_names_every_step_program():
+    names = {s.name for s in build_registry()}
+    assert names == {"train_step", "eval_step", "nested_eval_step",
+                     "plc_predict", "topk_predict", "shard_map_train_step"}
+    for spec in build_registry():
+        # every entry either donates or documents why it must not
+        assert spec.donate or spec.no_donate_reason, spec.name
+
+
+def test_self_audit_repo_is_clean(audit):
+    assert audit.findings == [], [str(f) for f in audit.findings]
+
+
+def test_train_steps_donation_fully_aliased(audit):
+    """The MFU item's donation audit: every donated state byte is aliased
+    in BOTH train-step executables — no buffer round-trips HBM."""
+    for name in ("train_step", "shard_map_train_step"):
+        don = audit.specs[name].evidence["donation"]
+        assert don["donated_bytes"] > 10_000_000, (name, don)  # real state
+        assert don["donation_coverage"] == 1.0, (name, don)
+        assert don["unaliased"] == [], (name, don)
+
+
+def test_step_factories_lint_clean():
+    assert lint_step_factories() == []
+
+
+def test_cli_rc_sites_lint_clean():
+    assert lint_rc_sites() == []
+
+
+def test_analyze_cli_rc2_on_bad_pass():
+    from ddp_classification_pytorch_tpu.cli.analyze import main
+
+    with pytest.raises(SystemExit) as e:
+        main(["--passes", "bogus"])
+    assert e.value.code == 2
+
+
+def test_analyze_cli_rc1_on_findings(tmp_path):
+    """Findings → rc 1, proven via an explicit rc-lint target (the same
+    surface the CLI uses for the cli/ package)."""
+    from ddp_classification_pytorch_tpu.cli.analyze import main
+
+    bad = tmp_path / "bad_cli.py"
+    bad.write_text("import sys\nsys.exit(13)\n")
+    with pytest.raises(SystemExit) as e:
+        main(["--passes", "lint", "--rc-paths", str(bad)])
+    assert e.value.code == 1
+
+
+def test_analyze_cli_lint_pass_clean(capsys):
+    from ddp_classification_pytorch_tpu.cli.analyze import main
+
+    main(["--passes", "lint"])  # returns (rc 0) or raises SystemExit
+    assert "clean" in capsys.readouterr().out
+
+
+# ------------------------------------------------------- compile sentinel --
+
+
+def test_compile_sentinel_counts_compiles_not_cache_hits():
+    sent = CompileSentinel(tag="t").arm()
+    try:
+        @jax.jit
+        def fresh_fn(x):
+            return x * 3 + 1
+
+        fresh_fn(np.ones(3, np.float32))
+        assert any(e.name == "fresh_fn" for e in sent.take())
+        fresh_fn(np.ones(3, np.float32))  # cache hit: silent
+        assert [e for e in sent.take() if e.name == "fresh_fn"] == []
+        fresh_fn(np.ones(5, np.float32))  # new shape: recompile
+        with pytest.raises(SteadyStateRecompile):
+            sent.check(strict=True)
+        assert sent.violations >= 1
+    finally:
+        sent.disarm()
+    assert not sent.armed
+
+
+def test_compile_sentinel_event_carries_signature():
+    sent = CompileSentinel(tag="t").arm()
+    try:
+        @jax.jit
+        def sig_fn(x):
+            return x + 1
+
+        sig_fn(np.ones((2, 7), np.float32))
+        events = [e for e in sent.take() if e.name == "sig_fn"]
+        assert events and "2,7" in events[0].signature
+    finally:
+        sent.disarm()
+
+
+def _fake_predict():
+    """A tiny jitted predict with the serve signature — the engine's
+    compile accounting doesn't care that it isn't a model."""
+
+    @jax.jit
+    def step(state, images):
+        x = images.astype(jnp.float32).mean(axis=(1, 2, 3)) * state["w"]
+        scores = jnp.stack([x, -x], axis=1)
+        idx = jnp.broadcast_to(jnp.arange(2, dtype=jnp.int32), scores.shape)
+        return scores, idx
+
+    return step
+
+
+def _engine(predict, **kw):
+    from ddp_classification_pytorch_tpu.serve.engine import ServingEngine
+
+    kw.setdefault("image_size", 8)
+    kw.setdefault("input_dtype", "uint8")
+    kw.setdefault("max_batch", 4)
+    kw.setdefault("batch_timeout_ms", 5.0)
+    kw.setdefault("buckets", (2, 4))
+    return ServingEngine({"w": jnp.ones(())}, predict, **kw)
+
+
+def test_serve_warmup_asserts_exact_compile_count_and_stays_armed():
+    predict = _fake_predict()
+    engine = _engine(predict)
+    try:
+        engine.warmup()  # cold predict: exactly len(buckets) programs
+        assert engine.compiled_programs() == 2
+        assert engine.compile_sentinel is not None
+        assert engine.compile_sentinel.armed
+        # a second engine over the now-warm predict must not false-positive
+        engine2 = _engine(predict)
+        engine2.warmup()
+        engine2.close()
+    finally:
+        engine.close()
+
+
+def test_serve_steady_state_recompile_counted_and_strict_fatal():
+    from ddp_classification_pytorch_tpu.serve.metrics import ServeMetrics
+
+    predict = _fake_predict()
+    metrics = ServeMetrics()
+    engine = _engine(predict, metrics=metrics, strict_compile=True)
+    try:
+        engine.warmup()
+        # steady state: a bucket-shaped batch is a cache hit, no violation
+        engine.submit(np.zeros((8, 8, 3), np.uint8))
+        assert engine.process_once() == 1
+        assert metrics.snapshot()["recompiles"] == 0
+        # someone sneaks a non-bucket shape through the shared predict:
+        # the NEXT batch boundary must catch the compile
+        predict({"w": jnp.ones(())}, np.zeros((3, 8, 8, 3), np.uint8))
+        engine.submit(np.zeros((8, 8, 3), np.uint8))
+        with pytest.raises(SteadyStateRecompile):
+            engine.process_once()
+        assert engine.fatal_error is not None
+        assert metrics.snapshot()["recompiles"] >= 1
+        assert engine.closed  # intake stopped
+    finally:
+        engine.close()
+
+
+def test_donation_evidence_fields():
+    """bench.py's e2e evidence rides this helper: the fields must exist and
+    a fully-aliasable donated arg must report coverage 1.0."""
+    fn = jax.jit(lambda s, x: (s + x.sum(), x * 2), donate_argnums=0)
+    ev = donation_evidence(fn, (jnp.zeros((32, 32), jnp.float32),
+                                jax.ShapeDtypeStruct((4,), jnp.float32)))
+    assert ev["donated_bytes"] == 32 * 32 * 4
+    assert ev["donation_coverage"] == 1.0
+    assert ev["unaliased"] == []
+    assert isinstance(ev["temp_bytes"], int)
+
+
+def test_finding_renders_as_one_line():
+    f = Finding("donation", "train_step", "gap", {"bytes": 4})
+    assert str(f) == "[donation] train_step: gap"

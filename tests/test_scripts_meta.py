@@ -111,6 +111,26 @@ def test_chaos_drill_flags_match_train_cli():
         assert needle in body, f"chaos_drill.sh lost its {needle!r} phase piece"
 
 
+def test_lint_script_flags_match_analyze_cli():
+    """scripts/lint.sh is the CI gate for cli.analyze: every --flag it
+    passes must exist in the analyze parser, and it must actually run the
+    analyzer (the drift failure mode this file exists to guard)."""
+    from ddp_classification_pytorch_tpu.cli.analyze import build_parser
+
+    known = set()
+    for action in build_parser()._actions:
+        known.update(action.option_strings)
+    body = _script_body("lint.sh")
+    assert "ddp_classification_pytorch_tpu.cli.analyze" in body
+    passed = set(re.findall(r"(?<![\w-])--[a-z_]+", body))
+    assert passed, "lint.sh passes no flags — gate gutted?"
+    unknown = sorted(passed - known)
+    assert not unknown, f"lint.sh passes flags cli.analyze rejects: {unknown}"
+    # the gate must run BOTH pass families, on CPU
+    assert "jaxpr" in body and "lint" in body
+    assert "JAX_PLATFORMS=cpu" in body
+
+
 def test_worklist_bench_step_captures_serve_row():
     """The owed-work list must keep running bench with BOTH evidence rows:
     --e2e (uint8 wire) and --serve (serve_latency) — a silently dropped
