@@ -394,6 +394,26 @@ def _build_topk_predict(ctx: AuditContext):
     return fn, (state, ctx.images())
 
 
+def _build_train_survivor(ctx: AuditContext):
+    """The re-formed-pod program: after elastic membership shrinks the
+    world (parallel/fleet.py), the trainer rebuilds the SAME step
+    factory on a mesh resolved for the survivor device count — a
+    different jaxpr (no cross-device collectives at world 1), so it
+    gets its own audit entry per the registry NOTE."""
+    from ..parallel import mesh as meshlib
+    from ..train.state import create_train_state
+    from ..train.steps import make_train_step
+
+    if "survivor" not in ctx._cache:
+        mesh = meshlib.make_mesh(devices=jax.devices()[:1])
+        cfg = ctx.tiny_cfg("baseline")
+        model, tx, state = create_train_state(cfg, mesh, steps_per_epoch=4)
+        ctx._cache["survivor"] = (cfg, model, tx, state, mesh)
+    cfg, model, tx, state, mesh = ctx._cache["survivor"]
+    fn = make_train_step(cfg, model, tx, mesh=mesh)
+    return fn, (state, ctx.images(), ctx.labels())
+
+
 def _build_shard_map_train(ctx: AuditContext):
     from ..parallel.collectives import build_ddp_model, make_shard_map_train_step
     from ..train.schedule import build_optimizer
@@ -454,6 +474,13 @@ def build_registry() -> List[StepSpec]:
             name="train_step",
             factory="ddp_classification_pytorch_tpu.train.steps:make_train_step",
             build=_build_train,
+            donate=(0,),
+            uint8_input=True,
+        ),
+        StepSpec(
+            name="train_step_survivor",
+            factory="ddp_classification_pytorch_tpu.train.steps:make_train_step",
+            build=_build_train_survivor,
             donate=(0,),
             uint8_input=True,
         ),

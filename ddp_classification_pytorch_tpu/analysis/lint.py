@@ -34,9 +34,10 @@ from typing import Iterable, List, Optional, Sequence, Tuple
 from . import Finding
 
 # the documented exit codes (docs/operations.md failure-mode matrix +
-# bench.py's 5 "deadline" row); signal deaths (130/137/143) are raised by
-# the runtime, never by our code, so they are deliberately NOT listed
-RC_CATALOGUE = frozenset({0, 1, 2, 3, 4, 5, 6, 7, 8, 9})
+# bench.py's 5 "deadline" row + the elastic pod codes: 10 pod-unviable,
+# 11 pod-reform); signal deaths (130/137/143) are raised by the runtime,
+# never by our code, so they are deliberately NOT listed
+RC_CATALOGUE = frozenset({0, 1, 2, 3, 4, 5, 6, 7, 8, 9, 10, 11})
 
 # call idioms that synchronize the host against the device (or smuggle host
 # wall-clock into a trace) when they appear inside a step factory

@@ -489,15 +489,46 @@ def main(argv: Optional[Sequence[str]] = None) -> None:
         # keyed off the shared $OUT/generation file; terminal failure is
         # rc 6 (outage-shaped — supervise.sh backs off OUTAGE_BACKOFF_S
         # and tries again instead of giving up fast)
-        from ..parallel.fleet import RendezvousFailed, initialize_with_retry
+        from ..parallel.fleet import (FleetConfigError, PodInconsistent,
+                                      PodUnviable, RendezvousFailed,
+                                      initialize_with_retry)
+        from ..parallel.mesh import MeshSpec
 
+        # the configured mesh gates elastic viability: a survivor world
+        # whose device count cannot cover it is rc 10, not a
+        # construction-time crash after rendezvous
+        spec = MeshSpec(cfg.parallel.data_axis, cfg.parallel.model_axis,
+                        max(cfg.parallel.pipeline_stages, 1))
         try:
-            initialize_with_retry(out_dir=cfg.run.out_dir)
+            initialize_with_retry(out_dir=cfg.run.out_dir, mesh_spec=spec)
+        except FleetConfigError as e:
+            import sys
+
+            # malformed FLEET_* launch env: deterministic, so the same
+            # rc 2 as every other config error — supervise.sh must stop,
+            # not replay the bad env MAX_RESTARTS times
+            print(f"[trainer] config error: {e}", file=sys.stderr)
+            raise SystemExit(FleetConfigError.exit_code) from None
+        except PodUnviable as e:
+            import sys
+
+            # rc 10 = "pod-unviable": the survivor set is too small (or
+            # does not divide into the mesh) — outage-shaped for the
+            # supervisor, since dead peers may come back
+            print(f"[trainer] pod-unviable: {e}", file=sys.stderr)
+            raise SystemExit(PodUnviable.exit_code) from None
         except RendezvousFailed as e:
             import sys
 
             print(f"[trainer] {e}", file=sys.stderr)
             raise SystemExit(RendezvousFailed.exit_code) from None
+        except PodInconsistent as e:
+            import sys
+
+            # the post-rendezvous membership digest agreement failed:
+            # split-brain world views — same rc 9 as a split-brain resume
+            print(f"[trainer] pod-inconsistent: {e}", file=sys.stderr)
+            raise SystemExit(PodInconsistent.exit_code) from None
     if (args.world_size is not None or args.local_rank is not None
             or args.gpu is not None):
         print("[compat] --world_size/--local_rank/--gpu are ignored on TPU: "
@@ -519,7 +550,7 @@ def main(argv: Optional[Sequence[str]] = None) -> None:
         # pretrained-checkpoint conversion are host work that can legitimately
         # exceed the watchdog on reference-scale data, and the backend is
         # already initialized at this point
-    from ..parallel.fleet import PodAbort, PodInconsistent
+    from ..parallel.fleet import PodAbort, PodInconsistent, PodReform
 
     trainer_cls = PLCTrainer if cfg.workload == "plc" else Trainer
     try:
@@ -581,6 +612,15 @@ def main(argv: Optional[Sequence[str]] = None) -> None:
         # supervisors classify one failure, not N different ones
         print(f"[trainer] {e}", file=sys.stderr)
         raise SystemExit(e.code) from None
+    except PodReform as e:
+        import sys
+
+        # rc 11 = "pod-reform": the epoch-boundary exchange observed a
+        # membership change (lost member's lease expired, or a recovered
+        # host's fresh lease) — every host exits together and the
+        # supervisors respawn them into the re-formed world fast
+        print(f"[trainer] pod-reform: {e}", file=sys.stderr)
+        raise SystemExit(PodReform.exit_code) from None
 
 
 if __name__ == "__main__":

@@ -38,9 +38,27 @@ epoch-boundary only — the step loop is untouched):
    diverged, SIGTERM received), so a deterministic stop on one host
    becomes the SAME rc on all hosts within one epoch instead of an
    indefinite collective hang.
-4. **Pod chaos** (utils/chaos.py `peer_dead` / `peer_slow`, gated
-   per-process by ``CHAOS_HOST``) drives the whole chain end-to-end in
-   scripts/chaos_drill.sh phase 3+.
+4. **Pod chaos** (utils/chaos.py `peer_dead` / `peer_slow` /
+   `host_lost`, gated per-process by ``CHAOS_HOST``) drives the whole
+   chain end-to-end in scripts/chaos_drill.sh phase 3+.
+5. **Elastic re-formation** (``FLEET_ELASTIC=1`` on explicit pods):
+   every host maintains a lease file under ``$OUT/fleet/`` (written at
+   rendezvous, refreshed at the trainer's log cadence and every epoch
+   boundary — never inside the step), and rendezvous derives the pod
+   membership from the FRESH leases instead of the frozen
+   ``FLEET_NUM_PROCESSES``/``FLEET_PROCESS_ID`` env: survivors of a
+   host loss agree on a shrunken world (sorted surviving host ids →
+   contiguous ranks, generation+1), prove the agreement with the same
+   all-gathered digest machinery as resume consensus (split-brain ⇒
+   deterministic `PodInconsistent` rc 9), and re-initialize with a
+   topology resolved for the survivor count (`parallel/mesh.py`) —
+   resuming through the topology-free consensus restore. A world too
+   small (``FLEET_MIN_PROCESSES``) or not divisible into the
+   configured mesh is the deterministic `PodUnviable` rc 10, never a
+   hang; a running pod that observes a membership change (a dead
+   member's lease expired, or a recovered host's fresh lease) exits
+   `PodReform` rc 11 at the epoch boundary so every supervisor
+   restarts it into the re-formed world at a later generation.
 
 The collective primitives (`_broadcast_host` / `_allgather_host`) are
 module-level indirection so single-process unit tests stub them with
@@ -52,6 +70,7 @@ collective.
 from __future__ import annotations
 
 import os
+import re
 import sys
 import time
 from typing import Any, Callable, Optional, Tuple
@@ -86,6 +105,40 @@ class PodInconsistent(RuntimeError):
     race, so supervise.sh retries it with `RUNTIME_BACKOFF_S`."""
 
     exit_code = 9
+
+
+class FleetConfigError(ValueError):
+    """Malformed ``FLEET_*`` launch env (non-integer
+    ``FLEET_NUM_PROCESSES``, a coordinator address that is not
+    host:port, a process id outside the world). rc 2 — deterministic:
+    restarting replays the same bad env, so supervise.sh must stop
+    instead of burning its retry budget (previously these surfaced as
+    raw tracebacks swallowed into rc 6 retries)."""
+
+    exit_code = 2
+
+
+class PodUnviable(RuntimeError):
+    """The survivor set cannot form a trainable pod: fewer hosts than
+    ``FLEET_MIN_PROCESSES``, or the surviving device count does not
+    divide into the configured mesh. rc 10 — deterministic on every
+    host (the same lease scan derives the same world), never a hang;
+    outage-shaped for the supervisor (dead peers may come back), so
+    supervise.sh backs off ``OUTAGE_BACKOFF_S`` and retries within its
+    restart budget."""
+
+    exit_code = 10
+
+
+class PodReform(RuntimeError):
+    """A running pod observed a membership change at the epoch
+    boundary: a member's lease went stale (host lost) or a non-member
+    wrote a fresh lease (recovered host rejoining). rc 11 — every host
+    exits together so the supervisors restart them into a re-formed
+    world at the next generation; supervise.sh restarts it fast
+    (``REFORM_BACKOFF_S``, default 2 s)."""
+
+    exit_code = 11
 
 
 class PodAbort(RuntimeError):
@@ -286,9 +339,10 @@ def _shutdown_distributed() -> None:
 def initialize_with_retry(
     out_dir: str = "",
     *,
-    initialize: Optional[Callable[[], None]] = None,
+    initialize: Optional[Callable[..., None]] = None,
     sleep: Callable[[float], None] = time.sleep,
     env: Optional[dict] = None,
+    mesh_spec: Any = None,
 ) -> int:
     """`jax.distributed.initialize` with bounded exponential backoff and
     a hard deadline. Returns the generation this attempt belongs to
@@ -296,41 +350,117 @@ def initialize_with_retry(
     its attempt number there before every restart, so all hosts log and
     pace the same generation).
 
-    Knobs (env): ``FLEET_COORDINATOR`` / ``FLEET_NUM_PROCESSES`` /
-    ``FLEET_PROCESS_ID`` for explicit (non-TPU-metadata) pods,
-    ``FLEET_RENDEZVOUS_ATTEMPTS`` (5), ``FLEET_RENDEZVOUS_BACKOFF_S``
-    (5, doubling), ``FLEET_RENDEZVOUS_BACKOFF_CAP_S`` (60),
+    Knobs (env, parsed by `validate_fleet_env` — malformed values raise
+    `FleetConfigError` rc 2 up front): ``FLEET_COORDINATOR`` /
+    ``FLEET_NUM_PROCESSES`` / ``FLEET_PROCESS_ID`` for explicit
+    (non-TPU-metadata) pods, ``FLEET_RENDEZVOUS_ATTEMPTS`` (5),
+    ``FLEET_RENDEZVOUS_BACKOFF_S`` (5, doubling),
+    ``FLEET_RENDEZVOUS_BACKOFF_CAP_S`` (60),
     ``FLEET_RENDEZVOUS_TIMEOUT_S`` (60, per attempt),
     ``FLEET_RENDEZVOUS_DEADLINE_S`` (600, hard wall across attempts).
+
+    With ``FLEET_ELASTIC=1`` (and an out_dir), every attempt derives the
+    world from the FRESH leases instead of the frozen env: write own
+    lease → scan → (settle-sleep once if smaller than configured) →
+    viability gate (`PodUnviable` rc 10) → the LOWEST surviving host id
+    caches the derived view in ``$OUT/fleet/membership`` (bumping the
+    generation when the world changed) → initialize with contiguous
+    ranks over the sorted survivor ids → digest agreement over the
+    joined world (`PodInconsistent` rc 9 on split-brain). The injected
+    ``initialize`` receives ``(coordinator, num_processes, process_id)``.
 
     Terminal failure raises `RendezvousFailed` (rc 6): outage-shaped —
     the peers may simply not have restarted yet — so supervise.sh backs
     off `OUTAGE_BACKOFF_S` and tries again rather than giving up fast.
+    `PodUnviable`/`PodInconsistent` re-raise immediately (deterministic
+    on this lease view — retrying in-process cannot change the answer).
     """
+    global _CURRENT_MEMBERSHIP
     e = os.environ if env is None else env
-    attempts = max(int(e.get("FLEET_RENDEZVOUS_ATTEMPTS", "5")), 1)
-    base = float(e.get("FLEET_RENDEZVOUS_BACKOFF_S", "5"))
-    cap = float(e.get("FLEET_RENDEZVOUS_BACKOFF_CAP_S", "60"))
-    timeout_s = int(float(e.get("FLEET_RENDEZVOUS_TIMEOUT_S", "60")))
-    deadline = float(e.get("FLEET_RENDEZVOUS_DEADLINE_S", "600"))
+    knobs = validate_fleet_env(e)  # FleetConfigError (rc 2) before any retry
+    attempts = knobs["attempts"]
+    timeout_s = knobs["timeout_s"]
+    deadline = knobs["deadline_s"]
+    elastic = bool(out_dir) and elastic_enabled(e)
     gen = read_generation(generation_path(out_dir)) if out_dir else 0
     if initialize is None:
-        coordinator = e.get("FLEET_COORDINATOR", "")
-        nprocs = e.get("FLEET_NUM_PROCESSES", "")
-        pid = e.get("FLEET_PROCESS_ID", "")
-        initialize = lambda: _jax_initialize(  # noqa: E731
-            coordinator, nprocs, pid, timeout_s)
+        initialize = lambda c, n, p: _jax_initialize(  # noqa: E731
+            c, n, p, timeout_s)
 
-    delays = backoff_schedule(attempts, base, cap)
+    delays = backoff_schedule(attempts, knobs["backoff_s"],
+                              knobs["backoff_cap_s"])
     start = time.monotonic()
     last: Optional[BaseException] = None
     for attempt in range(attempts):
         try:
-            initialize()
+            if elastic:
+                host_id = knobs["host_id"]
+                gen = read_generation(generation_path(out_dir))
+                write_lease(out_dir, host_id, generation=gen,
+                            coordinator=knobs["self_coordinator"])
+                leases = scan_leases(out_dir, ttl_s=knobs["lease_ttl_s"])
+                leases[host_id] = knobs["self_coordinator"]
+                if (knobs["num_processes"] is not None
+                        and len(leases) < knobs["num_processes"]
+                        and knobs["settle_s"] > 0):
+                    # first-boot settle: peers may not have written their
+                    # first lease yet — don't flap into a shrunken world
+                    sleep(knobs["settle_s"])
+                    leases = scan_leases(out_dir, ttl_s=knobs["lease_ttl_s"])
+                    leases[host_id] = knobs["self_coordinator"]
+                world = sorted(leases)
+                check_viable(world, min_processes=knobs["min_processes"],
+                             local_devices=knobs["local_devices"],
+                             mesh_spec=mesh_spec)
+                stored_gen, stored_world = read_membership(out_dir)
+                reform = bool(stored_world) and stored_world != world
+                if (reform and host_id not in stored_world
+                        and world[0] != host_id):
+                    # a REJOINER: the survivors are still running the old
+                    # world — connecting now would abort against a
+                    # coordinator sized without us (observed: an instant
+                    # SIGABRT crash storm burning the supervisor's restart
+                    # budget). Our fresh lease is the signal; wait in the
+                    # retry loop until their epoch-boundary reform check
+                    # fires and the membership writer records a world that
+                    # contains us. (When WE are the lowest survivor, we
+                    # are that writer — fall through and re-form.)
+                    raise RuntimeError(
+                        f"host {host_id} waiting for survivors "
+                        f"{stored_world} to re-form around its fresh "
+                        "lease (membership not yet updated)")
+                gen = max(gen, stored_gen) + (1 if reform else 0)
+                if world[0] == host_id:
+                    # single writer: every survivor derives the same view
+                    # deterministically; only the lowest id caches it, so
+                    # a rejoiner cannot overwrite the survivors' record
+                    # before they have re-formed around it
+                    if reform:
+                        advance_generation(generation_path(out_dir), gen)
+                    write_membership(out_dir, gen, world)
+                if reform:
+                    print(f"[fleet] re-formed pod: world {world} "
+                          f"(was {stored_world}) at generation {gen}",
+                          flush=True)
+                rank = world.index(host_id)
+                coord = leases.get(world[0], "") or knobs["coordinator"]
+                initialize(coord, len(world), rank)
+                _CURRENT_MEMBERSHIP = (gen, tuple(world))
+                confirm_membership(world)
+                print(f"[fleet] rendezvous ok (generation={gen}, "
+                      f"attempt={attempt + 1}/{attempts}, "
+                      f"world={','.join(str(h) for h in world)}, "
+                      f"rank={rank})", flush=True)
+                return gen
+            initialize(knobs["coordinator"], knobs["num_processes"] or 0,
+                       knobs["process_id"] or 0)
             print(f"[fleet] rendezvous ok "
                   f"(generation={gen}, attempt={attempt + 1}/{attempts})",
                   flush=True)
             return gen
+        except (PodUnviable, PodInconsistent):
+            _shutdown_distributed()
+            raise
         except Exception as exc:  # timeout / connection refused / barrier
             last = exc
             _shutdown_distributed()
@@ -383,9 +513,285 @@ def advance_generation(path: str, target: int) -> int:
     return target
 
 
+# ------------------------------------------------------ elastic pods --
+# The (generation, world) this process rendezvoused into — written by the
+# elastic path of `initialize_with_retry`, read by FleetCoordinator's
+# reform detection so a membership change is judged against the world the
+# RUNNING program was built for, not against a file a rejoiner may have
+# already rewritten.
+_CURRENT_MEMBERSHIP: Optional[Tuple[int, Tuple[int, ...]]] = None
+
+
+def _env_int(e: dict, key: str) -> Optional[int]:
+    raw = str(e.get(key, "") or "").strip()
+    if raw == "":
+        return None
+    try:
+        return int(raw)
+    except ValueError:
+        raise FleetConfigError(
+            f"{key}={raw!r} is not an integer — rc 2: fix the launch env "
+            "(restarting replays the same bad value)") from None
+
+
+def _env_float(e: dict, key: str, default: float) -> float:
+    raw = str(e.get(key, "") or "").strip()
+    if raw == "":
+        return default
+    try:
+        return float(raw)
+    except ValueError:
+        raise FleetConfigError(
+            f"{key}={raw!r} is not a number — rc 2: fix the launch env "
+            "(restarting replays the same bad value)") from None
+
+
+def _local_devices_hint(e: dict) -> int:
+    """Devices this host will contribute, WITHOUT touching the backend
+    (jax.local_device_count() would initialize it before
+    jax.distributed.initialize): ``FLEET_LOCAL_DEVICES`` wins, else the
+    CPU harness's forced device count from XLA_FLAGS, else 1 (one
+    accelerator process per host)."""
+    v = _env_int(e, "FLEET_LOCAL_DEVICES")
+    if v is not None:
+        return max(v, 1)
+    m = re.search(r"--xla_force_host_platform_device_count=(\d+)",
+                  str(e.get("XLA_FLAGS", "") or ""))
+    return max(int(m.group(1)), 1) if m else 1
+
+
+def validate_fleet_env(env: Optional[dict] = None) -> dict:
+    """Parse and validate every FLEET_* knob up front, BEFORE any retry
+    loop — a malformed value is a deterministic `FleetConfigError`
+    (rc 2) with the offending key named, not a raw traceback swallowed
+    into rc 6 rendezvous retries. Returns the parsed knobs with
+    defaults applied."""
+    e = os.environ if env is None else env
+    nprocs = _env_int(e, "FLEET_NUM_PROCESSES")
+    pid = _env_int(e, "FLEET_PROCESS_ID")
+    coordinator = str(e.get("FLEET_COORDINATOR", "") or "").strip()
+    if coordinator:
+        host, sep, port = coordinator.rpartition(":")
+        if not sep or not host or not port.isdigit():
+            raise FleetConfigError(
+                f"FLEET_COORDINATOR={coordinator!r} is not host:port — "
+                "rc 2: fix the launch env")
+        if nprocs is None or pid is None:
+            raise FleetConfigError(
+                "FLEET_COORDINATOR is set but FLEET_NUM_PROCESSES / "
+                "FLEET_PROCESS_ID is missing — rc 2: explicit pods need "
+                "all three")
+    if nprocs is not None and nprocs < 1:
+        raise FleetConfigError(
+            f"FLEET_NUM_PROCESSES={nprocs} must be >= 1 — rc 2")
+    if pid is not None and nprocs is not None and not 0 <= pid < nprocs:
+        raise FleetConfigError(
+            f"FLEET_PROCESS_ID={pid} outside the world "
+            f"[0, {nprocs}) — rc 2")
+    host_id = _env_int(e, "FLEET_HOST_ID")
+    if host_id is None:
+        host_id = pid if pid is not None else 0
+    if host_id < 0:
+        raise FleetConfigError(f"FLEET_HOST_ID={host_id} must be >= 0 — rc 2")
+    min_procs = _env_int(e, "FLEET_MIN_PROCESSES")
+    self_coord = str(e.get("FLEET_COORDINATOR_SELF", "") or "").strip()
+    return {
+        "coordinator": coordinator,
+        "num_processes": nprocs,
+        "process_id": pid,
+        "host_id": host_id,
+        "min_processes": max(min_procs, 1) if min_procs is not None else 1,
+        "local_devices": _local_devices_hint(e),
+        # the address this host would serve as coordinator if it became
+        # rank 0 of a re-formed world; host id 0 defaults to the
+        # configured coordinator (same process, same bindable port)
+        "self_coordinator": self_coord or (coordinator if host_id == 0 else ""),
+        "attempts": max(_env_int(e, "FLEET_RENDEZVOUS_ATTEMPTS") or 5, 1),
+        "backoff_s": _env_float(e, "FLEET_RENDEZVOUS_BACKOFF_S", 5.0),
+        "backoff_cap_s": _env_float(e, "FLEET_RENDEZVOUS_BACKOFF_CAP_S", 60.0),
+        "timeout_s": int(_env_float(e, "FLEET_RENDEZVOUS_TIMEOUT_S", 60.0)),
+        "deadline_s": _env_float(e, "FLEET_RENDEZVOUS_DEADLINE_S", 600.0),
+        "lease_ttl_s": _env_float(e, "FLEET_LEASE_TTL_S", 600.0),
+        "settle_s": _env_float(e, "FLEET_LEASE_SETTLE_S", 2.0),
+    }
+
+
+def elastic_enabled(env: Optional[dict] = None) -> bool:
+    """Elastic re-formation is opt-in (``FLEET_ELASTIC=1``) and only for
+    EXPLICIT pods (coordinator + world from env): TPU-metadata pods have
+    a fixed hardware topology — a survivor subset cannot re-form the
+    ICI mesh, so elastic membership would only mask a real outage."""
+    e = os.environ if env is None else env
+    return (str(e.get("FLEET_ELASTIC", "") or "") not in ("", "0")
+            and bool(str(e.get("FLEET_COORDINATOR", "") or "").strip())
+            and bool(str(e.get("FLEET_NUM_PROCESSES", "") or "").strip()))
+
+
+def fleet_dir(out_dir: str) -> str:
+    return os.path.join(out_dir, "fleet")
+
+
+def lease_path(out_dir: str, host_id: int) -> str:
+    return os.path.join(fleet_dir(out_dir), f"lease.p{int(host_id)}")
+
+
+def write_lease(out_dir: str, host_id: int, *, generation: int = 0,
+                coordinator: str = "") -> str:
+    """Atomically (re)write this host's lease. Freshness is the file
+    mtime — every write IS the heartbeat; the payload carries the host
+    id, the generation it was serving, and the coordinator address this
+    host would serve if it became rank 0 of a re-formed world."""
+    d = fleet_dir(out_dir)
+    os.makedirs(d, exist_ok=True)
+    path = lease_path(out_dir, host_id)
+    tmp = f"{path}.tmp.{os.getpid()}"
+    with open(tmp, "w") as f:
+        f.write(f"host={int(host_id)} gen={int(generation)} "
+                f"coord={coordinator}\n")
+    os.replace(tmp, path)
+    return path
+
+
+def scan_leases(out_dir: str, *, ttl_s: float,
+                now: Optional[float] = None) -> dict:
+    """Fresh leases under ``$OUT/fleet/``: {host_id: coordinator
+    candidate}. A lease older than ``ttl_s`` (mtime) is a dead host; a
+    torn or vanishing lease file is skipped — scan failures must never
+    brick the restart chain."""
+    d = fleet_dir(out_dir)
+    now = time.time() if now is None else now
+    fresh: dict = {}
+    try:
+        names = os.listdir(d)
+    except OSError:
+        return fresh
+    for name in names:
+        suffix = name[len("lease.p"):]
+        if not name.startswith("lease.p") or not suffix.isdigit():
+            continue
+        path = os.path.join(d, name)
+        try:
+            if now - os.stat(path).st_mtime > ttl_s:
+                continue
+            coord = ""
+            with open(path) as f:
+                for tok in f.read().split():
+                    if tok.startswith("coord="):
+                        coord = tok[len("coord="):]
+            fresh[int(suffix)] = coord
+        except OSError:
+            continue
+    return fresh
+
+
+# ------------------------------------------------------- membership --
+def membership_path(out_dir: str) -> str:
+    return os.path.join(fleet_dir(out_dir), "membership")
+
+
+def membership_line(generation: int, world) -> str:
+    """One shell- and python-parseable line: ``gen=G world=0,1``."""
+    return (f"gen={int(generation)} "
+            f"world={','.join(str(int(h)) for h in world)}")
+
+
+def membership_digest(world) -> str:
+    """sha256 of the canonical world — what `confirm_membership`
+    all-gathers after rendezvous. Deliberately EXCLUDES the generation:
+    supervisors max-write the generation file concurrently, so two
+    hosts of one valid world may read adjacent values mid-wave; the
+    split-brain being guarded against is a disagreeing WORLD."""
+    import hashlib
+
+    canon = ",".join(str(int(h)) for h in sorted(world))
+    return hashlib.sha256(canon.encode("utf-8")).hexdigest()
+
+
+def write_membership(out_dir: str, generation: int, world) -> None:
+    """Atomic tmp+replace of ``$OUT/fleet/membership`` — the cache of
+    the latest derived view (the leases stay the authority) that
+    supervise.sh re-reads before each respawn."""
+    d = fleet_dir(out_dir)
+    os.makedirs(d, exist_ok=True)
+    path = membership_path(out_dir)
+    tmp = f"{path}.tmp.{os.getpid()}"
+    with open(tmp, "w") as f:
+        f.write(membership_line(generation, world) + "\n")
+    os.replace(tmp, path)
+
+
+def read_membership(out_dir: str) -> Tuple[int, list]:
+    """(generation, world) from the membership file; (0, []) when
+    absent or garbled (a torn write must not brick the chain)."""
+    try:
+        with open(membership_path(out_dir)) as f:
+            text = f.read()
+    except OSError:
+        return 0, []
+    gen, world = 0, []
+    try:
+        for tok in text.split():
+            if tok.startswith("gen="):
+                gen = int(tok[len("gen="):])
+            elif tok.startswith("world="):
+                world = [int(x) for x in tok[len("world="):].split(",") if x]
+    except ValueError:
+        return 0, []
+    return gen, world
+
+
+def check_viable(world, *, min_processes: int = 1, local_devices: int = 1,
+                 mesh_spec: Any = None) -> None:
+    """Deterministic viability gate for a derived survivor world —
+    raises `PodUnviable` (rc 10) instead of letting an impossible pod
+    rendezvous and hang (or crash into rc 6 retries forever)."""
+    world = sorted(world)
+    if len(world) < max(min_processes, 1):
+        raise PodUnviable(
+            f"survivor set {world} has {len(world)} host(s), below "
+            f"FLEET_MIN_PROCESSES={min_processes} — rc 10: waiting for "
+            "lost hosts to rejoin (supervise.sh backs off and retries "
+            "within its restart budget)")
+    if mesh_spec is not None:
+        from . import mesh as meshlib
+
+        n = len(world) * max(local_devices, 1)
+        if not meshlib.viable_world(mesh_spec, n):
+            raise PodUnviable(
+                f"survivor world {world} contributes {n} device(s), which "
+                f"does not divide into the configured mesh "
+                f"(dp={mesh_spec.data_parallel or 'auto'}×"
+                f"mp={mesh_spec.model_parallel}×"
+                f"pp={mesh_spec.pipeline_parallel}) — rc 10: shrink the "
+                "mesh axes or wait for lost hosts")
+
+
+def confirm_membership(world) -> None:
+    """Post-rendezvous split-brain check: every host contributes the
+    sha256 of the world it believes it just joined to one all-gather
+    (the same digest-agreement machinery as resume consensus). Any
+    disagreement is `PodInconsistent` (rc 9) on every host — a pod
+    whose members derived different worlds from a racing lease scan
+    must die loudly, not train split-brained."""
+    if _process_count() == 1:
+        return
+    local = _encode_fixed(membership_digest(world), DIGEST_BYTES)
+    gathered = _allgather_host(np.asarray(local, np.uint8))
+    gathered = gathered.reshape(-1, DIGEST_BYTES)
+    if not (gathered == gathered[0]).all():
+        bad = sorted(
+            int(p) for p in range(gathered.shape[0])
+            if not bool((gathered[p] == gathered[0]).all()))
+        raise PodInconsistent(
+            f"membership agreement failed: host(s) {bad} rendezvoused "
+            f"with a different world than {sorted(world)} — refusing a "
+            "split-brain pod (rc 9); the supervised retry re-derives "
+            "membership from the leases")
+
+
 # ---------------------------------------------------- abort propagation --
 class FleetCoordinator:
-    """Epoch-boundary abort propagation.
+    """Epoch-boundary abort propagation + elastic reform detection.
 
     Each host accumulates at most one abort intent (`note_abort`): the
     sentinel's rc 8, a deferred SIGTERM (143), a config-shaped stop.
@@ -397,19 +803,45 @@ class FleetCoordinator:
     stop within one epoch instead of an indefinite hang at the next
     collective (and never a misleading heartbeat rc 7).
 
-    One tiny int32 all-gather per epoch: strictly off the hot path.
-    Single-process pods short-circuit (no collective), making the class
+    On elastic pods the same exchange carries a second lane: each host
+    refreshes its lease, re-scans, and flags when the derived world no
+    longer matches the one this program rendezvoused into (a member's
+    lease expired, or a recovered host wrote a fresh one). Any flag
+    raises `PodReform` (rc 11) on every host so the supervisors respawn
+    them into the re-formed world — still exactly ONE tiny int32
+    all-gather per epoch (an (n, 2) [abort_code, reform_flag] wire;
+    gloo aborts on interleaved independent collectives, so the two
+    lanes must share one).
+
+    Strictly off the hot path. Single-process pods short-circuit (no
+    collective) but still detect reform locally, making the class
     inert-but-testable everywhere.
     """
 
     def __init__(self, process_index: Optional[int] = None,
-                 process_count: Optional[int] = None):
+                 process_count: Optional[int] = None, *,
+                 out_dir: str = "", host_id: Optional[int] = None):
         self.process_index = (_process_index() if process_index is None
                               else int(process_index))
         self.process_count = (_process_count() if process_count is None
                               else int(process_count))
         self.abort_code = 0
         self.abort_reason = ""
+        self.out_dir = out_dir
+        self.elastic = bool(out_dir) and elastic_enabled()
+        if self.elastic:
+            knobs = validate_fleet_env()
+            self.host_id = (knobs["host_id"] if host_id is None
+                            else int(host_id))
+            self._coord_candidate = knobs["self_coordinator"]
+            self._lease_ttl_s = knobs["lease_ttl_s"]
+        else:
+            self.host_id = (self.process_index if host_id is None
+                            else int(host_id))
+            self._coord_candidate = ""
+            self._lease_ttl_s = 600.0
+        # the (generation, world) the running program was built for
+        self.membership = _CURRENT_MEMBERSHIP
 
     def note_abort(self, code: int, reason: str = "") -> None:
         """Record this host's abort intent (first one wins — the cause,
@@ -422,23 +854,65 @@ class FleetCoordinator:
                   + (f" ({reason})" if reason else "")
                   + " — propagating at the epoch boundary", flush=True)
 
+    def refresh_lease(self) -> None:
+        """Heartbeat for elastic membership: rewrite this host's lease
+        (the mtime IS the freshness signal). Called at the trainer's
+        log cadence and every epoch boundary — never inside the step;
+        inert on non-elastic pods."""
+        if not self.elastic:
+            return
+        gen = self.membership[0] if self.membership else 0
+        try:
+            write_lease(self.out_dir, self.host_id, generation=gen,
+                        coordinator=self._coord_candidate)
+        except OSError:
+            pass  # a transient shared-FS error must not kill the epoch
+
+    def _reform_flag(self) -> int:
+        """1 when the lease-derived world no longer matches the world
+        this program rendezvoused into, else 0."""
+        if not self.elastic or self.membership is None:
+            return 0
+        self.refresh_lease()
+        leases = scan_leases(self.out_dir, ttl_s=self._lease_ttl_s)
+        leases[self.host_id] = self._coord_candidate
+        return int(tuple(sorted(leases)) != self.membership[1])
+
+    def _exchange(self, reform_flag: int) -> Tuple[int, int, int]:
+        """One (n, 2) int32 all-gather of [abort_code, reform_flag] →
+        (pod_code, origin, pod_reform). Abort: largest intent across
+        the pod + the lowest host index carrying it ((0, -1) when
+        nobody aborts). Reform: any host's flag."""
+        local = np.asarray([[self.abort_code, int(reform_flag)]], np.int32)
+        if self.process_count == 1:
+            rows = local
+        else:
+            rows = _allgather_host(local).reshape(-1, 2)[: self.process_count]
+        codes = rows[:, 0]
+        code = int(codes.max()) if codes.size else 0
+        origin = int(np.argmax(codes == code)) if code else -1
+        reform = int(rows[:, 1].max()) if rows.size else 0
+        return code, origin, reform
+
     def exchange_abort(self) -> Tuple[int, int]:
         """(pod_code, origin): the largest intent across the pod and the
         lowest host index carrying it; (0, -1) when nobody aborts."""
-        local = np.asarray([self.abort_code], np.int32)
-        if self.process_count == 1:
-            codes = local
-        else:
-            codes = _allgather_host(local).reshape(-1)[: self.process_count]
-        code = int(codes.max()) if codes.size else 0
-        if not code:
-            return 0, -1
-        return code, int(np.argmax(codes == code))
+        code, origin, _ = self._exchange(0)
+        return code, origin
 
     def check(self) -> None:
         """Run the epoch-boundary exchange; raise `PodAbort` when any
-        host (including this one) carries an intent."""
-        code, origin = self.exchange_abort()
+        host (including this one) carries an intent, else `PodReform`
+        when any host observed a membership change (abort wins — a
+        deterministic stop outranks a reconfiguration)."""
+        code, origin, reform = self._exchange(self._reform_flag())
         if code:
             raise PodAbort(code, origin=origin, local_code=self.abort_code,
                            reason=self.abort_reason)
+        if reform:
+            world = list(self.membership[1]) if self.membership else []
+            raise PodReform(
+                f"pod membership changed (running world {world}) — "
+                "rc 11: exiting at the epoch boundary so every "
+                "supervisor respawns into the re-formed world at the "
+                "next generation")

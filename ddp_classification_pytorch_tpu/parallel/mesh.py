@@ -58,6 +58,21 @@ class MeshSpec:
         return dp, mp, pp
 
 
+def viable_world(spec: MeshSpec, n_devices: int) -> bool:
+    """Whether `spec` resolves over `n_devices` — the elastic membership
+    round's viability gate (parallel/fleet.py check_viable): a survivor
+    world whose device count cannot cover the configured mesh must be
+    the deterministic pod-unviable rc, not a construction-time crash
+    after rendezvous."""
+    if n_devices < 1:
+        return False
+    try:
+        spec.resolve(n_devices)
+    except ValueError:
+        return False
+    return True
+
+
 def make_mesh(spec: MeshSpec = MeshSpec(), devices: Optional[Sequence[Any]] = None) -> Mesh:
     devices = list(devices) if devices is not None else jax.devices()
     dp, mp, pp = spec.resolve(len(devices))

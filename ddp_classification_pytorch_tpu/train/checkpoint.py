@@ -366,7 +366,11 @@ class CheckpointManager:
         host_state = _to_host(state) if paths else None
         if not is_host0():
             return is_best
-        meta_updates: dict = {"last_epoch": epoch}
+        # world_size is resume PROVENANCE for elastic pods: the checkpoint
+        # itself is topology-free (restored leaves re-place onto the new
+        # mesh), but a cross-world resume is worth one loud log line
+        meta_updates: dict = {"last_epoch": epoch,
+                              "world_size": jax.process_count()}
         if is_best:
             meta_updates.update(
                 best_epoch=epoch,
@@ -481,16 +485,32 @@ class CheckpointManager:
                 continue
             # resume best-tracking too, or the first post-resume epoch would
             # clobber ckpt_best regardless of its metric
-            self.best_metric = self.read_meta().get("best_metric", float("-inf"))
+            meta = self.read_meta()
+            self.best_metric = meta.get("best_metric", float("-inf"))
+            self._note_cross_world_resume(meta)
             return state, e + 1, path, self.file_digest(path)
         if os.path.exists(self.best_path):
             state = self._restore_verified(template_state, self.best_path)
             if state is not None:
                 meta = self.read_meta()
                 self.best_metric = meta.get("best_metric", float("-inf"))
+                self._note_cross_world_resume(meta)
                 return (state, int(meta.get("best_epoch", -1)) + 1,
                         self.best_path, self.file_digest(self.best_path))
         return template_state, 0, None, None
+
+    @staticmethod
+    def _note_cross_world_resume(meta: dict) -> None:
+        """One loud line when the restoring world differs from the one
+        that wrote the checkpoint (elastic re-formation, or a deliberate
+        cross-topology resume) — the restore itself is topology-free."""
+        saved = meta.get("world_size")
+        if saved is not None and int(saved) != jax.process_count():
+            host0_print(
+                f"[ckpt] cross-world resume: checkpoint written by a "
+                f"{int(saved)}-process pod, restoring into "
+                f"{jax.process_count()} (topology-free restore re-places "
+                "every leaf onto the current mesh)")
 
     def restore_exact(self, template_state: Any, path: str,
                       expected_digest: str) -> Optional[Any]:

@@ -187,10 +187,15 @@ class Trainer:
             host0_print(f"[chaos] fault plan active: {self.chaos}")
         # pod coordination (parallel/fleet.py): epoch-boundary abort
         # propagation + SIGTERM deferral, multi-process runs only — a
-        # single-process Trainer keeps today's behavior bit-for-bit
-        self.fleet = (fleetlib.FleetCoordinator()
-                      if jax.process_count() > 1 else None)
-        if self.fleet is not None:
+        # single-process Trainer keeps today's behavior bit-for-bit.
+        # Elastic pods keep the coordinator even at process_count()==1:
+        # a lone survivor must still heartbeat its lease and detect a
+        # recovered peer's fresh lease (PodReform) at epoch boundaries.
+        elastic = fleetlib.elastic_enabled() and bool(cfg.run.out_dir)
+        self.fleet = (fleetlib.FleetCoordinator(out_dir=cfg.run.out_dir
+                                                if elastic else "")
+                      if jax.process_count() > 1 or elastic else None)
+        if self.fleet is not None and jax.process_count() > 1:
             self._defer_sigterm_to_epoch_boundary()
         # non-finite step policy: skip counting + rc-8 escalation
         # (train/sentinel.py); the streak carries across epochs
@@ -397,6 +402,7 @@ class Trainer:
                     self.chaos.maybe_sigterm(step=self._host_step - 1)
                     self.chaos.maybe_peer_dead(step=self._host_step - 1)
                     self.chaos.maybe_peer_slow(step=self._host_step - 1)
+                    self.chaos.maybe_host_lost(step=self._host_step - 1)
                 if step % self.cfg.run.log_every == 0:
                     if eta is not None:
                         # the only host sync per log_every steps (reference
@@ -410,6 +416,11 @@ class Trainer:
                     # _sentinel_flush).
                     self._sentinel_flush()
                     self._heartbeat.touch()
+                    if self.fleet is not None:
+                        # elastic lease heartbeat on the same cadence: a
+                        # live mid-epoch host must never look dead to a
+                        # rejoiner's lease scan (inert on non-elastic pods)
+                        self.fleet.refresh_lease()
                     if self.compile_sentinel.armed:
                         # mid-epoch recompile detection at the same cadence;
                         # warn-only here — strict enforcement waits for the

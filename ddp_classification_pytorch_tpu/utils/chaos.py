@@ -29,6 +29,10 @@ drives injection hooks planted at four points:
   reference's single worst failure mode — SURVEY §5).
 - ``peer_slow`` — the step loop sleeps ``CHAOS_PEER_SLOW_S`` seconds
   (default 15) on the matching global step: a straggling host.
+- ``host_lost`` — the step loop SIGKILLs its whole PROCESS GROUP on the
+  matching global step: the machine (trainer AND its supervise.sh) is
+  gone, not just the trainer — the elastic re-formation scenario, where
+  no local supervisor will ever bring the host back.
 
 Ranges: ``@step=7`` (one step), ``@step=7..9`` (inclusive), ``@step=7..``
 (every step from 7 on). Host-side faults (ckpt_io / loader_io / sigterm /
@@ -61,7 +65,7 @@ from dataclasses import dataclass
 from typing import List, Optional, Tuple
 
 KINDS = ("nan_loss", "ckpt_io", "loader_io", "sigterm", "peer_dead",
-         "peer_slow")
+         "peer_slow", "host_lost")
 UNITS = ("step", "epoch", "batch")
 
 ENV_SPEC = "CHAOS_FAULT_SPEC"
@@ -158,7 +162,7 @@ class FaultPlan:
             if kind == "nan_loss" and unit != "step":
                 raise ValueError("nan_loss is keyed by the in-jit step "
                                  "counter; use nan_loss@step=...")
-            if kind in ("peer_dead", "peer_slow") and unit != "step":
+            if kind in ("peer_dead", "peer_slow", "host_lost") and unit != "step":
                 raise ValueError(f"{kind} is keyed by the host-side step "
                                  f"counter; use {kind}@step=...")
             faults.append(Fault(kind, unit, lo, hi))
@@ -276,6 +280,17 @@ class FaultPlan:
             print(f"# chaos: host {self.process_index} dies (SIGKILL) at "
                   f"step {step} ({f})", file=sys.stderr, flush=True)
             os.kill(os.getpid(), signal.SIGKILL)
+
+    def maybe_host_lost(self, *, step: int) -> None:
+        """Step-loop hook: SIGKILL this host's whole process group —
+        trainer AND supervisor die together (the drill runs each host
+        under setsid), so nothing local restarts it. The surviving
+        hosts' lease scans must re-form the pod without it."""
+        f = self.should_fire("host_lost", step=step)
+        if f is not None:
+            print(f"# chaos: host {self.process_index} lost (SIGKILL "
+                  f"group) at step {step} ({f})", file=sys.stderr, flush=True)
+            os.killpg(os.getpgid(0), signal.SIGKILL)
 
     def maybe_peer_slow(self, *, step: int) -> None:
         """Step-loop hook: stall this host ``CHAOS_PEER_SLOW_S`` seconds

@@ -31,16 +31,31 @@
 # surface as the SAME rc 8 on the peer within one epoch boundary via the
 # fleet abort exchange: no indefinite hang, no spurious rc 7, no restart.
 #
+# Phase 6 (elastic pod, must converge to rc 0): two ELASTIC supervised
+# hosts (FLEET_ELASTIC=1, each under setsid so a host loss can take its
+# supervisor too) and a host_lost SIGKILL-the-group on host 1 mid-epoch-1.
+# Host 0 must re-form as a 1-process pod once host 1's lease expires
+# (restarts.log shows the world transition 2 -> 1), keep training from the
+# last verified checkpoint, then — when host 1 is relaunched — observe its
+# fresh lease at an epoch boundary, exit rc 11, and re-form back to 2
+# hosts at a later generation (1 -> 2). Both hosts finish rc 0.
+#
+# Phase 7 (elastic pod, must stop at rc 10 on the survivor — no hang):
+# same host loss, but FLEET_MIN_PROCESSES=2 makes the 1-host survivor set
+# unviable: host 0 must exit the deterministic rc 10 ("pod-unviable") on
+# every restart and give up within its budget instead of hanging in
+# rendezvous backoff forever.
+#
 # CPU-only, synthetic data, tiny model: runs anywhere in a few minutes.
-# Select phases with CHAOS_PHASES (default "1 2 3 4 5"); the pod phases
-# skip gracefully when the platform cannot host two CPU processes (a
-# forced non-cpu JAX_PLATFORMS means only one host's worth of real
+# Select phases with CHAOS_PHASES (default "1 2 3 4 5 6 7"); the pod
+# phases skip gracefully when the platform cannot host two CPU processes
+# (a forced non-cpu JAX_PLATFORMS means only one host's worth of real
 # devices is available).
 # Usage: [CHAOS_PHASES="3 4 5"] bash scripts/chaos_drill.sh [out_dir]
 set -u
 REPO=$(cd "$(dirname "$0")/.." && pwd)
 OUT=${1:-"$REPO/runs/chaos_drill"}
-PHASES=${CHAOS_PHASES:-"1 2 3 4 5"}
+PHASES=${CHAOS_PHASES:-"1 2 3 4 5 6 7"}
 export JAX_PLATFORMS=${JAX_PLATFORMS:-cpu}
 
 COMMON=(baseline --dataset synthetic --platform cpu --model resnet18
@@ -253,6 +268,117 @@ grep -q "rc=7" "$P5/restarts.log" \
   && fail "spurious rc 7 — the abort exchange should beat the heartbeat"
 echo "[drill] phase 5 OK: one-host divergence stopped BOTH hosts at rc 8," \
      "no hang, no rc 7, no restart"
+fi
+fi
+
+# -------------------------------------------------------- elastic phases --
+# Each elastic host runs under setsid: host_lost SIGKILLs its whole process
+# group, so trainer AND supervisor die together — nothing local restarts
+# the host, which is exactly the scenario re-formation exists for. Short
+# lease TTL + rendezvous knobs keep the drill's re-form latency in seconds.
+launch_elastic_host() { # $1=out $2=host_id $3=port $4=min_procs $5=spec [extra...]
+  local out=$1 hid=$2 port=$3 minp=$4 spec=$5; shift 5
+  setsid env XLA_FLAGS=--xla_force_host_platform_device_count=1 \
+      FLEET_ELASTIC=1 FLEET_COORDINATOR="localhost:$port" \
+      FLEET_NUM_PROCESSES=2 FLEET_PROCESS_ID="$hid" FLEET_HOST_ID="$hid" \
+      FLEET_MIN_PROCESSES="$minp" \
+      FLEET_LEASE_TTL_S=25 FLEET_LEASE_SETTLE_S=2 \
+      FLEET_RENDEZVOUS_ATTEMPTS=8 FLEET_RENDEZVOUS_BACKOFF_S=2 \
+      FLEET_RENDEZVOUS_BACKOFF_CAP_S=5 FLEET_RENDEZVOUS_TIMEOUT_S=15 \
+      FLEET_RENDEZVOUS_DEADLINE_S=240 \
+      CHAOS_HOST="${CHAOS_HOST:-}" \
+      MAX_RESTARTS="${ELASTIC_MAX_RESTARTS:-8}" RUNTIME_BACKOFF_S=1 \
+      OUTAGE_BACKOFF_S="${ELASTIC_OUTAGE_BACKOFF_S:-2}" REFORM_BACKOFF_S=1 \
+    bash "$REPO/scripts/supervise.sh" "${POD_COMMON[@]}" \
+      --multihost --hang_timeout_s 120 \
+      --out "$out" --fault_spec "$spec" "$@" \
+      > "$out/host$hid.log" 2>&1 &
+  launched_pid=$!  # global on purpose: $(...) would orphan the pid for wait
+}
+
+wait_for_membership() { # $1=out $2=want world $3=liveness pid $4=deadline_s
+  local t=0
+  while [ "$t" -lt "$4" ]; do
+    grep -q "world=$2\$" "$1/fleet/membership" 2>/dev/null && return 0
+    kill -0 "$3" 2>/dev/null || return 1
+    sleep 2; t=$((t + 2))
+  done
+  return 1
+}
+
+# ---------------------------------------------------------------- phase 6 --
+if has_phase 6; then
+if ! pod_available; then
+  echo "[drill] phase 6 SKIPPED: pod drill needs the CPU virtual-device harness"
+else
+P6="$OUT/pod_elastic"
+rm -rf "$P6"; mkdir -p "$P6"
+SPEC6="host_lost@step=6"  # 4 steps/epoch: the host vanishes in epoch 1
+echo "[drill] phase 6: $SPEC6 on host 1 (CHAOS_HOST=1), elastic re-formation"
+PORT6=$(free_port)
+CHAOS_HOST=1 launch_elastic_host "$P6" 0 "$PORT6" 1 "$SPEC6" --epochs 4
+pid0=$launched_pid
+CHAOS_HOST=1 launch_elastic_host "$P6" 1 "$PORT6" 1 "$SPEC6" --epochs 4
+pid1=$launched_pid
+wait "$pid1"; r1=$?
+[ "$r1" -eq 137 ] || fail "phase 6: host 1 group exited rc=$r1, want 137 (SIGKILL)"
+grep -q "chaos: host 1 lost (SIGKILL group)" "$P6/host1.log" \
+  || fail "host_lost never fired on host 1"
+# survivors re-form once the dead host's lease expires (TTL 25s)
+wait_for_membership "$P6" 0 "$pid0" 240 \
+  || fail "host 0 never re-formed as a 1-host pod (see $P6/host0.log)"
+echo "[drill] phase 6: world shrank to [0]; relaunching host 1 (rejoin)"
+mv "$P6/host1.log" "$P6/host1.lost.log"
+CHAOS_HOST=1 launch_elastic_host "$P6" 1 "$PORT6" 1 "$SPEC6" --epochs 4
+pid1=$launched_pid
+wait "$pid1"; r1=$?
+wait "$pid0"; r0=$?
+[ "$r0" -eq 0 ] || fail "phase 6: host 0 exited rc=$r0, want 0 (see $P6/host0.log)"
+[ "$r1" -eq 0 ] || fail "phase 6: rejoined host 1 exited rc=$r1, want 0 (see $P6/host1.log)"
+grep -q "re-formed pod" "$P6/host0.log" \
+  || fail "host 0 never logged the re-formation"
+grep -q "rc=11" "$P6/restarts.log" \
+  || fail "no rc 11 (pod-reform) event — the rejoin was never observed"
+grep -q "world=0 action" "$P6/restarts.log" \
+  || fail "restarts.log never recorded the shrunken world (2 -> 1)"
+grep -q "world=0,1 action" "$P6/restarts.log" \
+  || fail "restarts.log never recorded the re-grown world (1 -> 2)"
+g6=$(sed -n 's/^gen=\([0-9]*\).*/\1/p' "$P6/fleet/membership")
+[ -n "$g6" ] && [ "$g6" -ge 2 ] \
+  || fail "membership generation '$g6' never advanced through two re-formations"
+[ -f "$P6/ckpt_e3.msgpack" ] || fail "final epoch checkpoint missing"
+echo "[drill] phase 6 OK: pod re-formed 2 -> 1 -> 2 (generation $g6)," \
+     "converged rc 0 on both hosts"
+fi
+fi
+
+# ---------------------------------------------------------------- phase 7 --
+if has_phase 7; then
+if ! pod_available; then
+  echo "[drill] phase 7 SKIPPED: pod drill needs the CPU virtual-device harness"
+else
+P7="$OUT/pod_unviable"
+rm -rf "$P7"; mkdir -p "$P7"
+SPEC7="host_lost@step=6"
+echo "[drill] phase 7: $SPEC7 on host 1, min_processes=2 — survivor must" \
+     "exit rc 10, not hang"
+PORT7=$(free_port)
+CHAOS_HOST=1 ELASTIC_MAX_RESTARTS=3 ELASTIC_OUTAGE_BACKOFF_S=1 \
+  launch_elastic_host "$P7" 0 "$PORT7" 2 "$SPEC7" --epochs 3
+pid0=$launched_pid
+CHAOS_HOST=1 ELASTIC_MAX_RESTARTS=3 ELASTIC_OUTAGE_BACKOFF_S=1 \
+  launch_elastic_host "$P7" 1 "$PORT7" 2 "$SPEC7" --epochs 3
+pid1=$launched_pid
+wait "$pid1"; r1=$?
+[ "$r1" -eq 137 ] || fail "phase 7: host 1 group exited rc=$r1, want 137 (SIGKILL)"
+wait "$pid0"; r0=$?
+[ "$r0" -eq 10 ] || fail "phase 7: host 0 exited rc=$r0, want 10 (see $P7/host0.log)"
+grep -q "pod-unviable" "$P7/host0.log" \
+  || fail "host 0 never named the unviable survivor set"
+grep -q "rc=10" "$P7/restarts.log" \
+  || fail "restarts.log never classified the rc-10 give-up"
+echo "[drill] phase 7 OK: unviable survivor set exited deterministic" \
+     "rc 10 within its restart budget — no hang"
 fi
 fi
 

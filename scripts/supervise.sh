@@ -19,6 +19,15 @@
 # against it so per-host backoff drift cannot make hosts miss each other's
 # rendezvous window.
 #
+# Elastic pods (FLEET_ELASTIC=1): the trainer caches the lease-derived
+# membership in $OUT/fleet/membership (one line: gen=G world=0,1). Before
+# each restart this supervisor re-reads it and re-exports
+# FLEET_PROCESS_ID/FLEET_NUM_PROCESSES as this host's rank/size in the
+# re-formed world — respawning into the CURRENT membership instead of the
+# frozen launch env. Every restarts.log line also records gen=/world= so
+# the re-formation history (2 -> 1 -> 2 after a rejoin) reads off one
+# shared log.
+#
 # Usage: MAX_RESTARTS=5 bash scripts/supervise.sh <workload> --out runs/x [flags...]
 set -u
 max=${MAX_RESTARTS:-5}
@@ -33,16 +42,52 @@ for a in "$@"; do
   prev="$a"
 done
 
-# process identity for shared (pod) restart logs: FLEET_PROCESS_ID is the
-# same env the trainer's rendezvous uses; single-host runs show proc=-
+# process identity for shared (pod) restart logs: FLEET_HOST_ID is stable
+# across elastic re-formations (ranks are not), falling back to
+# FLEET_PROCESS_ID; single-host runs show proc=-
 host=$(hostname 2>/dev/null || echo "?")
-proc=${FLEET_PROCESS_ID:--}
+proc=${FLEET_HOST_ID:-${FLEET_PROCESS_ID:--}}
+
+mem_fields() { # -> "gen=G world=0,1" from $OUT/fleet/membership, "-" absent
+  g="-"; w="-"
+  if [ -n "$out" ] && [ -f "$out/fleet/membership" ]; then
+    line=$(head -n 1 "$out/fleet/membership" 2>/dev/null || echo "")
+    case "$line" in gen=*)
+      g=${line#gen=}; g=${g%% *}
+      w=${line##*world=}; w=${w%% *}
+    ;; esac
+  fi
+  echo "gen=$g world=$w"
+}
 
 log_event() { # $1=rc $2=backoff $3=action
   [ -n "$out" ] || return 0
   mkdir -p "$out" 2>/dev/null || return 0
-  echo "$(date -Is) host=$host proc=$proc rc=$1 backoff=${2}s attempt=$n/$max action=$3" \
+  echo "$(date -Is) host=$host proc=$proc rc=$1 backoff=${2}s attempt=$n/$max $(mem_fields) action=$3" \
     >> "$out/restarts.log"
+}
+
+reexport_membership() { # respawn into the re-formed world (elastic pods)
+  [ -n "${FLEET_ELASTIC:-}" ] && [ "${FLEET_ELASTIC:-0}" != "0" ] || return 0
+  [ -n "$out" ] && [ -f "$out/fleet/membership" ] || return 0
+  line=$(head -n 1 "$out/fleet/membership" 2>/dev/null || echo "")
+  w=${line##*world=}; w=${w%% *}
+  [ -n "$w" ] && [ "$w" != "$line" ] || return 0
+  me=${FLEET_HOST_ID:-${FLEET_PROCESS_ID:-}}
+  [ -n "$me" ] || return 0
+  rank=0; size=0; found=""
+  oldIFS=$IFS; IFS=','
+  for h in $w; do
+    [ "$h" = "$me" ] && { found=1; rank=$size; }
+    size=$((size + 1))
+  done
+  IFS=$oldIFS
+  # only members re-export: a recovered host NOT yet in the cached world
+  # keeps its launch env and rejoins when the survivors re-form around it
+  if [ -n "$found" ] && [ "$size" -gt 0 ]; then
+    export FLEET_PROCESS_ID="$rank" FLEET_NUM_PROCESSES="$size"
+  fi
+  return 0
 }
 
 bump_generation() { # max-write our attempt number into $OUT/generation
@@ -60,7 +105,10 @@ bump_generation() { # max-write our attempt number into $OUT/generation
 while true; do
   python -m ddp_classification_pytorch_tpu.cli.train "$@" --auto_resume
   rc=$?
-  [ "$rc" -eq 0 ] && exit 0
+  # a clean exit is logged too: on elastic pods the world transitions
+  # (2 -> 1 -> 2) are reconstructed from restarts.log, and the final
+  # converged state must appear there, not just the failures
+  [ "$rc" -eq 0 ] && { log_event 0 0 exit; exit 0; }
   # rc classification lives HERE, one level below any window scheduler:
   # 2 is deterministic (config/usage — the trainer maps its own config
   # validation to SystemExit(2), same code argparse uses) — restarting
@@ -97,8 +145,15 @@ while true; do
     3) backoff=${OUTAGE_BACKOFF_S:-300} ;;
     6) backoff=${OUTAGE_BACKOFF_S:-300} ;;
     9) backoff=${RUNTIME_BACKOFF_S:-30} ;;
+    10) backoff=${OUTAGE_BACKOFF_S:-300} ;;
+    11) backoff=${REFORM_BACKOFF_S:-2} ;;
     *) backoff=2 ;;
   esac
+  # 10 is "pod-unviable" (parallel/fleet.py: the survivor set is below
+  # FLEET_MIN_PROCESSES or cannot cover the mesh) — outage-shaped like
+  # rc 3/6, the dead peers may come back, so the long backoff; 11 is
+  # "pod-reform" (membership changed at the epoch boundary) — every host
+  # exits together ON PURPOSE, so restart fast into the re-formed world.
   n=$((n + 1))
   if [ "$n" -gt "$max" ]; then
     echo "[supervise] giving up after $n failures (last rc=$rc)" >&2
@@ -109,5 +164,6 @@ while true; do
        "${backoff}s backoff)" >&2
   log_event "$rc" "$backoff" restart
   bump_generation
+  reexport_membership
   sleep "$backoff"
 done

@@ -200,7 +200,8 @@ def test_rc_lint_passes_catalogued_patterns():
 def test_registry_names_every_step_program():
     names = {s.name for s in build_registry()}
     assert names == {"train_step", "eval_step", "nested_eval_step",
-                     "plc_predict", "topk_predict", "shard_map_train_step"}
+                     "plc_predict", "topk_predict", "shard_map_train_step",
+                     "train_step_survivor"}
     for spec in build_registry():
         # every entry either donates or documents why it must not
         assert spec.donate or spec.no_donate_reason, spec.name

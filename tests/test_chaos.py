@@ -431,9 +431,13 @@ def test_supervise_appends_restart_lines(tmp_path):
         env=env, capture_output=True, text=True, timeout=30)
     assert p.returncode == 0, p.stderr
     lines = (out / "restarts.log").read_text().strip().splitlines()
-    assert len(lines) == 2  # one per non-zero exit; the clean exit logs none
+    # one per non-zero exit, plus the final clean exit (elastic pods
+    # reconstruct their world transitions from this log, so the
+    # converged state must appear there too)
+    assert len(lines) == 3
     assert "rc=1" in lines[0] and "action=restart" in lines[0]
     assert "rc=143" in lines[1] and "attempt=2/" in lines[1]
+    assert "rc=0" in lines[2] and "action=exit" in lines[2]
 
 
 # ------------------------------------------------------------ full drill --

@@ -218,7 +218,7 @@ def test_verify_checkpoint_tolerates_file_vanishing_mid_verify(tmp_path, monkeyp
 def test_rendezvous_retries_with_deterministic_backoff_then_succeeds(tmp_path):
     calls, slept = [], []
 
-    def flaky():
+    def flaky(*a):
         calls.append(1)
         if len(calls) < 3:
             raise TimeoutError("barrier timed out")
@@ -233,7 +233,7 @@ def test_rendezvous_retries_with_deterministic_backoff_then_succeeds(tmp_path):
 
 
 def test_rendezvous_exhaustion_raises_rc6(tmp_path):
-    def never():
+    def never(*a):
         raise ConnectionRefusedError("coordinator down")
 
     env = {"FLEET_RENDEZVOUS_ATTEMPTS": "3", "FLEET_RENDEZVOUS_BACKOFF_S": "1",
@@ -247,7 +247,7 @@ def test_rendezvous_exhaustion_raises_rc6(tmp_path):
 def test_rendezvous_deadline_cuts_the_schedule_short():
     calls = []
 
-    def never():
+    def never(*a):
         calls.append(1)
         raise TimeoutError("x")
 
@@ -266,7 +266,8 @@ def test_rendezvous_deadline_cuts_the_schedule_short():
 def test_rendezvous_reads_generation_for_logging(tmp_path):
     fleet.advance_generation(fleet.generation_path(str(tmp_path)), 4)
     gen = fleet.initialize_with_retry(
-        str(tmp_path), initialize=lambda: None, sleep=lambda s: None, env={})
+        str(tmp_path), initialize=lambda *a: None, sleep=lambda s: None,
+        env={})
     assert gen == 4
 
 
@@ -286,7 +287,8 @@ def test_generation_file_monotonicity(tmp_path):
 
 # -------------------------------------------------------- abort propagation --
 def test_abort_exchange_max_code_wins_on_every_host(monkeypatch):
-    recorded = np.asarray([[0], [8]], np.int32)
+    # (n, 2) wire: [abort_code, reform_flag] per host, one collective
+    recorded = np.asarray([[0, 0], [8, 0]], np.int32)
     monkeypatch.setattr(fleet, "_allgather_host", lambda x: recorded)
     co = fleet.FleetCoordinator(process_index=0, process_count=2)
     code, origin = co.exchange_abort()
@@ -304,13 +306,13 @@ def test_abort_note_first_intent_wins_and_clean_exchange_is_silent(monkeypatch):
     assert co.abort_code == 143 and "SIGTERM" in co.abort_reason
     monkeypatch.setattr(
         fleet, "_allgather_host",
-        lambda x: np.asarray([[0], [co.abort_code]], np.int32))
+        lambda x: np.asarray([[0, 0], [co.abort_code, 0]], np.int32))
     code, origin = co.exchange_abort()
     assert (code, origin) == (143, 1)
 
     clean = fleet.FleetCoordinator(process_index=0, process_count=2)
     monkeypatch.setattr(fleet, "_allgather_host",
-                        lambda x: np.zeros((2, 1), np.int32))
+                        lambda x: np.zeros((2, 2), np.int32))
     assert clean.exchange_abort() == (0, -1)
     clean.check()  # no intent anywhere: no raise, training continues
 
@@ -366,6 +368,25 @@ def test_peer_dead_sigkills_self_once(monkeypatch):
     assert len(kills) == 1
 
 
+def test_host_lost_sigkills_own_process_group_once(monkeypatch):
+    """host_lost must take out the WHOLE process group (supervisor and
+    trainer — a machine loss, not a process loss), exactly once."""
+    kills = []
+    monkeypatch.setattr(os, "getpgid", lambda pid: 4242)
+    monkeypatch.setattr(os, "killpg",
+                        lambda pg, sig: kills.append((pg, sig)))
+    plan = chaoslib.FaultPlan.parse("host_lost@step=6", process_index=0)
+    plan.maybe_host_lost(step=5)
+    assert kills == []
+    plan.maybe_host_lost(step=6)
+    assert kills == [(4242, signal.SIGKILL)]
+    plan.maybe_host_lost(step=6)  # one-shot
+    assert len(kills) == 1
+    # step-keyed only, like the other pod faults
+    with pytest.raises(ValueError, match="keyed by the host-side step"):
+        chaoslib.FaultPlan.parse("host_lost@epoch=1")
+
+
 def test_peer_slow_stalls_configured_seconds(monkeypatch):
     import time as timelib
 
@@ -393,6 +414,251 @@ def test_peer_fault_markers_are_per_host(tmp_path):
     p0b = chaoslib.FaultPlan.parse(spec, state_dir=str(tmp_path),
                                    process_index=0)
     assert p0b.should_fire("peer_slow", step=3) is None
+
+
+# ------------------------------------------------------ elastic membership --
+# Minimal explicit-pod env for the elastic path: host 0 of a configured
+# 2-host world, instant settle, generous TTL (tests backdate mtimes to
+# expire leases deterministically instead of sleeping).
+ELASTIC_ENV = {
+    "FLEET_ELASTIC": "1",
+    "FLEET_COORDINATOR": "localhost:1",
+    "FLEET_NUM_PROCESSES": "2",
+    "FLEET_PROCESS_ID": "0",
+    "FLEET_HOST_ID": "0",
+    "FLEET_LEASE_TTL_S": "100",
+    "FLEET_LEASE_SETTLE_S": "0",
+    "FLEET_RENDEZVOUS_ATTEMPTS": "2",
+    "FLEET_RENDEZVOUS_BACKOFF_S": "0",
+}
+
+
+def _expire_lease(out_dir, host_id):
+    p = fleet.lease_path(str(out_dir), host_id)
+    os.utime(p, (os.stat(p).st_mtime - 1000,) * 2)
+
+
+def test_lease_write_scan_and_stale_expiry(tmp_path):
+    out = str(tmp_path)
+    fleet.write_lease(out, 0, generation=3, coordinator="h0:12")
+    fleet.write_lease(out, 1, generation=3, coordinator="h1:12")
+    assert fleet.scan_leases(out, ttl_s=100) == {0: "h0:12", 1: "h1:12"}
+    # a lease past its TTL (mtime) is a dead host
+    _expire_lease(tmp_path, 1)
+    assert fleet.scan_leases(out, ttl_s=100) == {0: "h0:12"}
+    # re-writing IS the heartbeat: the lease comes back fresh
+    fleet.write_lease(out, 1, generation=4, coordinator="h1:12")
+    assert sorted(fleet.scan_leases(out, ttl_s=100)) == [0, 1]
+    # junk files in the fleet dir never brick the scan
+    (tmp_path / "fleet" / "lease.pX").write_text("not a lease\n")
+    (tmp_path / "fleet" / "membership").write_text("gen=1 world=0,1\n")
+    assert sorted(fleet.scan_leases(out, ttl_s=100)) == [0, 1]
+
+
+def test_membership_file_roundtrip_and_garbled(tmp_path):
+    out = str(tmp_path)
+    assert fleet.read_membership(out) == (0, [])  # absent
+    fleet.write_membership(out, 4, [0, 2])
+    assert fleet.read_membership(out) == (4, [0, 2])
+    with open(fleet.membership_path(out), "w") as f:
+        f.write("gen=x world=banana\n")  # torn/garbled ⇒ (0, []) not a crash
+    assert fleet.read_membership(out) == (0, [])
+
+
+def test_validate_fleet_env_malformed_is_rc2():
+    assert fleet.FleetConfigError.exit_code == 2
+    assert issubclass(fleet.FleetConfigError, ValueError)
+    with pytest.raises(fleet.FleetConfigError, match="FLEET_NUM_PROCESSES"):
+        fleet.validate_fleet_env({"FLEET_COORDINATOR": "localhost:1",
+                                  "FLEET_NUM_PROCESSES": "two",
+                                  "FLEET_PROCESS_ID": "0"})
+    with pytest.raises(fleet.FleetConfigError, match="host:port"):
+        fleet.validate_fleet_env({"FLEET_COORDINATOR": "localhost",
+                                  "FLEET_NUM_PROCESSES": "2",
+                                  "FLEET_PROCESS_ID": "0"})
+    with pytest.raises(fleet.FleetConfigError, match="all three"):
+        fleet.validate_fleet_env({"FLEET_COORDINATOR": "localhost:1"})
+    with pytest.raises(fleet.FleetConfigError, match="outside the world"):
+        fleet.validate_fleet_env({"FLEET_COORDINATOR": "localhost:1",
+                                  "FLEET_NUM_PROCESSES": "2",
+                                  "FLEET_PROCESS_ID": "5"})
+    with pytest.raises(fleet.FleetConfigError, match="FLEET_HOST_ID"):
+        fleet.validate_fleet_env({"FLEET_HOST_ID": "-3"})
+
+
+def test_elastic_first_boot_full_world_is_not_a_reform(tmp_path):
+    """Both configured hosts alive at first boot: the derived world equals
+    the configured one, generation stays put, and no re-formation is
+    recorded — elastic must be bit-identical to static when nothing died."""
+    out = str(tmp_path)
+    fleet.write_lease(out, 1, generation=0, coordinator="")
+    calls = []
+    gen = fleet.initialize_with_retry(
+        out, initialize=lambda c, n, p: calls.append((c, n, p)),
+        sleep=lambda s: None, env=dict(ELASTIC_ENV))
+    assert calls == [("localhost:1", 2, 0)]
+    assert gen == 0
+    assert fleet.read_membership(out) == (0, [0, 1])
+    assert fleet._CURRENT_MEMBERSHIP == (0, (0, 1))
+
+
+def test_elastic_survivor_reforms_shrunken_world_at_next_generation(tmp_path):
+    """Host 1's lease expired while membership records [0, 1]: host 0
+    re-forms alone — rank 0 of a 1-process world, generation bumped, new
+    membership cached (the single writer is the lowest survivor)."""
+    out = str(tmp_path)
+    fleet.write_membership(out, 1, [0, 1])
+    fleet.write_lease(out, 1, generation=1, coordinator="h1:9")
+    _expire_lease(tmp_path, 1)
+    calls = []
+    gen = fleet.initialize_with_retry(
+        out, initialize=lambda c, n, p: calls.append((c, n, p)),
+        sleep=lambda s: None, env=dict(ELASTIC_ENV))
+    assert calls == [("localhost:1", 1, 0)]
+    assert gen == 2  # stored gen 1 + re-formation
+    assert fleet.read_membership(out) == (2, [0])
+    # the generation file was advanced so every supervisor paces gen 2
+    assert fleet.read_generation(fleet.generation_path(out)) == 2
+
+
+def test_elastic_rejoin_restores_full_world_at_later_generation(tmp_path):
+    """The recovered host wrote a fresh lease while membership records the
+    shrunken [0]: the next round re-forms [0, 1] at a LATER generation —
+    a rejoin is a re-formation, never a rewind."""
+    out = str(tmp_path)
+    fleet.write_membership(out, 2, [0])
+    fleet.write_lease(out, 1, generation=2, coordinator="h1:9")
+    calls = []
+    gen = fleet.initialize_with_retry(
+        out, initialize=lambda c, n, p: calls.append((c, n, p)),
+        sleep=lambda s: None, env=dict(ELASTIC_ENV))
+    assert calls == [("localhost:1", 2, 0)]
+    assert gen == 3
+    assert fleet.read_membership(out) == (3, [0, 1])
+
+
+def test_elastic_rejoiner_waits_for_survivors_to_reform(tmp_path):
+    """A recovered host whose fresh lease is NOT yet in the cached
+    membership must WAIT in the retry loop — connecting would abort
+    against a coordinator sized for the old world — and join as a
+    follower only once the writer records a world containing it."""
+    out = str(tmp_path)
+    env = dict(ELASTIC_ENV)
+    env["FLEET_PROCESS_ID"] = "1"
+    env["FLEET_HOST_ID"] = "1"
+    fleet.write_membership(out, 2, [0])
+    fleet.write_lease(out, 0, generation=2, coordinator="h0:9")
+    calls = []
+    with pytest.raises(fleet.RendezvousFailed, match="re-form"):
+        fleet.initialize_with_retry(
+            out, initialize=lambda *a: calls.append(a),
+            sleep=lambda s: None, env=env)
+    assert calls == []  # never connected into the old world
+    # only the writer (lowest survivor) records the new membership
+    assert fleet.read_membership(out) == (2, [0])
+    # the survivors re-formed around us: join as rank 1 of their world
+    fleet.write_membership(out, 3, [0, 1])
+    gen = fleet.initialize_with_retry(
+        out, initialize=lambda *a: calls.append(a),
+        sleep=lambda s: None, env=env)
+    assert calls == [("h0:9", 2, 1)]
+    assert gen == 3
+
+
+def test_elastic_unviable_below_min_processes_is_rc10_not_a_hang(tmp_path):
+    """A survivor set below FLEET_MIN_PROCESSES must raise PodUnviable
+    (rc 10) immediately — never burn the rendezvous retry budget waiting
+    for a world that cannot form."""
+    assert fleet.PodUnviable.exit_code == 10
+    env = dict(ELASTIC_ENV)
+    env["FLEET_MIN_PROCESSES"] = "2"
+    attempts = []
+    with pytest.raises(fleet.PodUnviable, match="rc 10"):
+        fleet.initialize_with_retry(
+            str(tmp_path), initialize=lambda *a: attempts.append(a),
+            sleep=lambda s: None, env=env)
+    assert attempts == []  # failed the viability gate, not the rendezvous
+
+
+def test_elastic_unviable_mesh_is_rc10(tmp_path):
+    """A survivor world whose device count cannot cover the configured
+    mesh is equally unviable — the gate consults mesh.viable_world."""
+    from ddp_classification_pytorch_tpu.parallel.mesh import MeshSpec
+
+    with pytest.raises(fleet.PodUnviable, match="mesh"):
+        fleet.initialize_with_retry(
+            str(tmp_path), initialize=lambda *a: None,
+            sleep=lambda s: None, env=dict(ELASTIC_ENV),
+            mesh_spec=MeshSpec(model_parallel=3))
+    # the same 1-host world with a coverable mesh rendezvouses fine
+    fleet.initialize_with_retry(
+        str(tmp_path), initialize=lambda *a: None, sleep=lambda s: None,
+        env=dict(ELASTIC_ENV), mesh_spec=MeshSpec())
+
+
+def test_confirm_membership_split_brain_is_rc9(monkeypatch):
+    """Two hosts rendezvoused with different derived worlds: the digest
+    agreement must kill BOTH (rc 9), never train split-brained."""
+    _pod(monkeypatch, 0, count=2)
+    a = fleet._encode_fixed(fleet.membership_digest([0, 1]),
+                            fleet.DIGEST_BYTES)
+    b = fleet._encode_fixed(fleet.membership_digest([0]),
+                            fleet.DIGEST_BYTES)
+    monkeypatch.setattr(fleet, "_allgather_host",
+                        lambda x: np.stack([a, b]))
+    with pytest.raises(fleet.PodInconsistent, match="split-brain"):
+        fleet.confirm_membership([0, 1])
+    # agreement passes silently
+    monkeypatch.setattr(fleet, "_allgather_host",
+                        lambda x: np.stack([a, a]))
+    fleet.confirm_membership([0, 1])
+    # single process: no collective at all
+    _pod(monkeypatch, 0, count=1)
+    monkeypatch.setattr(fleet, "_allgather_host",
+                        lambda x: pytest.fail("collective on single host"))
+    fleet.confirm_membership([0])
+
+
+def _elastic_environ(monkeypatch, tmp_path):
+    for k, v in ELASTIC_ENV.items():
+        monkeypatch.setenv(k, v)
+    monkeypatch.setenv("FLEET_MIN_PROCESSES", "1")
+
+
+def test_coordinator_detects_membership_change_as_rc11(tmp_path, monkeypatch):
+    """A running 1-host pod whose membership was (0,): a recovered host's
+    fresh lease flips the epoch-boundary exchange into PodReform (rc 11)
+    — and an abort intent outranks the reform."""
+    assert fleet.PodReform.exit_code == 11
+    _elastic_environ(monkeypatch, tmp_path)
+    monkeypatch.setattr(fleet, "_CURRENT_MEMBERSHIP", (2, (0,)))
+    co = fleet.FleetCoordinator(process_index=0, process_count=1,
+                                out_dir=str(tmp_path))
+    assert co.elastic and co.membership == (2, (0,))
+    co.check()  # world still {0}: no abort, no reform
+    fleet.write_lease(str(tmp_path), 1, generation=2, coordinator="h1:9")
+    with pytest.raises(fleet.PodReform, match="rc 11"):
+        co.check()
+    co.note_abort(8, "diverged")
+    with pytest.raises(fleet.PodAbort) as ei:
+        co.check()  # abort wins over reform
+    assert ei.value.code == 8
+
+
+def test_coordinator_refresh_lease_heartbeats_mtime(tmp_path, monkeypatch):
+    _elastic_environ(monkeypatch, tmp_path)
+    monkeypatch.setattr(fleet, "_CURRENT_MEMBERSHIP", (1, (0,)))
+    co = fleet.FleetCoordinator(process_index=0, process_count=1,
+                                out_dir=str(tmp_path))
+    co.refresh_lease()
+    _expire_lease(tmp_path, 0)
+    assert fleet.scan_leases(str(tmp_path), ttl_s=100) == {}
+    co.refresh_lease()  # the heartbeat resurrects the mtime
+    assert sorted(fleet.scan_leases(str(tmp_path), ttl_s=100)) == [0]
+    # non-elastic coordinators are inert (no fleet dir ever created)
+    inert = fleet.FleetCoordinator(process_index=0, process_count=1)
+    assert not inert.elastic
+    inert.refresh_lease()
 
 
 # --------------------------------------------------- supervise.sh discipline --
@@ -430,10 +696,13 @@ def test_supervise_rc6_rendezvous_gets_outage_backoff_and_host_fields(tmp_path):
         env=env, capture_output=True, text=True, timeout=30)
     assert p.returncode == 0, p.stderr
     lines = (out / "restarts.log").read_text().strip().splitlines()
-    assert len(lines) == 1
+    assert len(lines) == 2  # the rc-6 restart + the final clean exit
     assert "rc=6" in lines[0] and "action=restart" in lines[0]
     assert "backoff=0s" in lines[0]  # OUTAGE_BACKOFF_S was honored
     assert "host=" in lines[0] and "proc=1" in lines[0]
+    # gen=/world= fields ride every line; "-" when no membership file
+    assert "gen=- world=-" in lines[0]
+    assert "rc=0" in lines[1] and "action=exit" in lines[1]
     # the restart wave max-wrote its attempt into the shared generation file
     assert (out / "generation").read_text().strip() == "1"
 
@@ -465,6 +734,88 @@ def test_supervise_generation_is_monotonic_across_waves(tmp_path):
     assert (out / "generation").read_text().strip() == "7"
 
 
+def test_supervise_rc10_pod_unviable_gets_outage_backoff(tmp_path):
+    out = tmp_path / "out"
+    env = _stub_env(tmp_path, "10,0")
+    env["OUTAGE_BACKOFF_S"] = "0"
+    p = subprocess.run(
+        ["bash", os.path.join(REPO, "scripts", "supervise.sh"),
+         "baseline", "--out", str(out)],
+        env=env, capture_output=True, text=True, timeout=30)
+    assert p.returncode == 0, p.stderr
+    log = (out / "restarts.log").read_text()
+    assert "rc=10" in log and "action=restart" in log
+    assert "backoff=0s" in log  # pod-unviable waits the OUTAGE backoff
+
+
+# a stub that also records the FLEET_* world each (re)spawn saw, so the
+# re-export into a re-formed membership is observable from outside
+ENV_STUB = """#!/usr/bin/env bash
+state="${FAKE_STATE:?}"
+n=$(cat "$state" 2>/dev/null || echo 0)
+n=$((n+1)); echo "$n" > "$state"
+echo "pid=${FLEET_PROCESS_ID:-?}/${FLEET_NUM_PROCESSES:-?}" >> "${FAKE_ENVLOG:?}"
+rc=$(echo "${FAKE_RCS:?}" | tr ',' '\\n' | sed -n "${n}p")
+[ -z "$rc" ] && rc=0
+exit "$rc"
+"""
+
+
+def _env_stub_env(tmp_path, rcs):
+    env = _stub_env(tmp_path, rcs)
+    (tmp_path / "bin" / "python").write_text(ENV_STUB)
+    env["FAKE_ENVLOG"] = str(tmp_path / "envlog")
+    return env
+
+
+def test_supervise_rc11_respawns_into_reformed_world(tmp_path):
+    """rc 11 restarts FAST and re-exports this host's rank/size from the
+    cached membership; restarts.log carries the gen=/world= fields."""
+    out = tmp_path / "out"
+    (out / "fleet").mkdir(parents=True)
+    (out / "fleet" / "membership").write_text("gen=3 world=0,2\n")
+    env = _env_stub_env(tmp_path, "11,0")
+    env["REFORM_BACKOFF_S"] = "0"
+    env["FLEET_ELASTIC"] = "1"
+    env["FLEET_HOST_ID"] = "2"
+    env["FLEET_PROCESS_ID"] = "2"
+    env["FLEET_NUM_PROCESSES"] = "3"
+    p = subprocess.run(
+        ["bash", os.path.join(REPO, "scripts", "supervise.sh"),
+         "baseline", "--out", str(out)],
+        env=env, capture_output=True, text=True, timeout=30)
+    assert p.returncode == 0, p.stderr
+    lines = (out / "restarts.log").read_text().strip().splitlines()
+    assert "rc=11" in lines[0] and "action=restart" in lines[0]
+    assert "backoff=0s" in lines[0]  # REFORM_BACKOFF_S: fast restart
+    assert "gen=3 world=0,2" in lines[0] and "proc=2" in lines[0]
+    # launch env 2/3; respawn re-exported as rank 1 of the 2-host world
+    envlog = (tmp_path / "envlog").read_text().splitlines()
+    assert envlog == ["pid=2/3", "pid=1/2"]
+
+
+def test_supervise_rejoiner_outside_cached_world_keeps_launch_env(tmp_path):
+    """A recovered host NOT (yet) in the cached membership must respawn
+    with its launch env — it rejoins when the survivors re-form around
+    its fresh lease, not by guessing a rank in a world it isn't in."""
+    out = tmp_path / "out"
+    (out / "fleet").mkdir(parents=True)
+    (out / "fleet" / "membership").write_text("gen=4 world=0\n")
+    env = _env_stub_env(tmp_path, "11,0")
+    env["REFORM_BACKOFF_S"] = "0"
+    env["FLEET_ELASTIC"] = "1"
+    env["FLEET_HOST_ID"] = "1"
+    env["FLEET_PROCESS_ID"] = "1"
+    env["FLEET_NUM_PROCESSES"] = "2"
+    p = subprocess.run(
+        ["bash", os.path.join(REPO, "scripts", "supervise.sh"),
+         "baseline", "--out", str(out)],
+        env=env, capture_output=True, text=True, timeout=30)
+    assert p.returncode == 0, p.stderr
+    envlog = (tmp_path / "envlog").read_text().splitlines()
+    assert envlog == ["pid=1/2", "pid=1/2"]
+
+
 # ---------------------------------------------------------- full pod drill --
 @pytest.mark.slow
 def test_pod_chaos_drill(tmp_path):
@@ -478,6 +829,26 @@ def test_pod_chaos_drill(tmp_path):
            if k not in (chaoslib.ENV_SPEC, chaoslib.ENV_STATE_DIR,
                         chaoslib.ENV_HOST)}
     env["CHAOS_PHASES"] = "3 4 5"
+    p = subprocess.run(
+        ["bash", os.path.join(REPO, "scripts", "chaos_drill.sh"),
+         str(tmp_path / "drill")],
+        cwd=REPO, env=env, capture_output=True, text=True, timeout=2400)
+    assert p.returncode == 0, (p.stdout[-5000:], p.stderr[-2000:])
+    assert "CHAOS DRILL PASS" in p.stdout
+
+
+@pytest.mark.slow
+def test_pod_elastic_drill(tmp_path):
+    """Elastic acceptance (chaos_drill.sh phases 6-7): SIGKILL of host 1's
+    whole process group mid-run ⇒ host 0 re-forms as a 1-host pod within
+    one generation and keeps training; host 1 relaunches, rejoins at a
+    later generation, and the 2-host pod converges rc 0 from the last
+    verified checkpoint. Then the same loss under FLEET_MIN_PROCESSES=2
+    ⇒ deterministic rc 10 on the survivor — never a hang."""
+    env = {k: v for k, v in os.environ.items()
+           if k not in (chaoslib.ENV_SPEC, chaoslib.ENV_STATE_DIR,
+                        chaoslib.ENV_HOST)}
+    env["CHAOS_PHASES"] = "6 7"
     p = subprocess.run(
         ["bash", os.path.join(REPO, "scripts", "chaos_drill.sh"),
          str(tmp_path / "drill")],

@@ -188,6 +188,33 @@ def test_hybrid_dcn_plus_pp_rejection_exits_2(capsys, tmp_path):
     assert "does not compose" in err
 
 
+def test_malformed_fleet_env_exits_2(capsys, tmp_path, monkeypatch):
+    """A malformed FLEET_* launch env is deterministic — every restart
+    replays the same bad value — so it must exit rc 2 with the offending
+    key NAMED, not dissolve into rc 6 rendezvous retries."""
+    monkeypatch.setenv("FLEET_COORDINATOR", "localhost:12345")
+    monkeypatch.setenv("FLEET_NUM_PROCESSES", "two")
+    monkeypatch.setenv("FLEET_PROCESS_ID", "0")
+    rc, err = _main_rc(
+        ["baseline", "--dataset", "synthetic", "--platform", "cpu",
+         "--multihost", "--epochs", "1", "--out", str(tmp_path)], capsys)
+    assert rc == 2, err[-500:]
+    assert "config error" in err
+    assert "FLEET_NUM_PROCESSES" in err
+
+
+def test_fleet_coordinator_without_port_exits_2(capsys, tmp_path, monkeypatch):
+    monkeypatch.setenv("FLEET_COORDINATOR", "localhost")
+    monkeypatch.setenv("FLEET_NUM_PROCESSES", "2")
+    monkeypatch.setenv("FLEET_PROCESS_ID", "0")
+    rc, err = _main_rc(
+        ["baseline", "--dataset", "synthetic", "--platform", "cpu",
+         "--multihost", "--epochs", "1", "--out", str(tmp_path)], capsys)
+    assert rc == 2, err[-500:]
+    assert "config error" in err
+    assert "host:port" in err
+
+
 def test_catcher_stops_loudly_on_broken_probe(tmp_path):
     """rc 127 (missing interpreter) / ImportError is a broken harness, not an
     outage — the catcher must stop with that rc, not poll forever."""

@@ -107,7 +107,10 @@ def test_chaos_drill_flags_match_train_cli():
     for needle in ("--multihost", "peer_dead@step=", "CHAOS_HOST=1",
                    "FLEET_COORDINATOR=", "FLEET_PROCESS_ID=",
                    "--hang_timeout_s", "nan_loss@step=",
-                   "ckpt_e1.msgpack.corrupt"):
+                   "ckpt_e1.msgpack.corrupt",
+                   # the elastic phases' load-bearing pieces
+                   "host_lost@step=", "FLEET_ELASTIC=",
+                   "FLEET_MIN_PROCESSES=", "FLEET_HOST_ID="):
         assert needle in body, f"chaos_drill.sh lost its {needle!r} phase piece"
 
 
