@@ -409,20 +409,29 @@ def _bench_e2e_row(cfg, mesh, *, steps: int, warmup: int, metric: str,
             model, tx, state = create_train_state(
                 cfg, mesh, steps_per_epoch=max(len(loader), 1))
             step = make_train_step(cfg, model, tx, mesh=mesh)
-            # donation/memory-analysis evidence (the ROADMAP's MFU item owes
-            # a donation audit so no step buffer round-trips HBM): AOT
+            # donation + comms/memory evidence (the ROADMAP's MFU item owes
+            # a donation audit so no step buffer round-trips HBM): ONE AOT
             # compile during the warmup window — the persistent cache makes
-            # it a cache hit on TPU — and read the executable's alias table
+            # it a cache hit on TPU — reads the executable's alias table,
+            # collective inventory, and memory budget in a single pass
             try:
-                from ddp_classification_pytorch_tpu.analysis.jaxpr_audit import (
-                    donation_evidence)
+                from ddp_classification_pytorch_tpu.analysis.sharding_audit import (
+                    step_comms_evidence)
+                from ddp_classification_pytorch_tpu.parallel.mesh import (
+                    batch_sharding)
 
                 h = cfg.data.image_size
                 np_dt = np.uint8 if cfg.data.input_dtype == "uint8" else np.float32
-                donation = donation_evidence(step, (
+                # the batch avals carry the data-axis sharding the real run
+                # uses (make_global_array's layout) — an unannotated aval
+                # would compile a fully-replicated program whose collective
+                # inventory is empty, not the hot step's
+                sh = batch_sharding(mesh)
+                donation = step_comms_evidence(step, (
                     state,
-                    jax.ShapeDtypeStruct((batch, h, h, 3), np_dt),
-                    jax.ShapeDtypeStruct((batch,), np.int32)))
+                    jax.ShapeDtypeStruct((batch, h, h, 3), np_dt, sharding=sh),
+                    jax.ShapeDtypeStruct((batch,), np.int32, sharding=sh)),
+                    mesh=mesh)
             except Exception as e:  # evidence must never cost the row
                 print(f"# donation evidence failed: {type(e).__name__}: {e}",
                       file=sys.stderr)
@@ -465,6 +474,12 @@ def _bench_e2e_row(cfg, mesh, *, steps: int, warmup: int, metric: str,
         "aliased_bytes": donation.get("aliased_bytes", 0),
         "donation_coverage": donation.get("donation_coverage"),
         "temp_bytes": donation.get("temp_bytes"),
+        # comms/memory evidence from the SAME compile (sharding_audit):
+        # per-step collective payload and the executable's peak HBM — the
+        # numbers `cli.analyze --diff-baseline` fences between TPU windows
+        "collective_bytes_per_step": donation.get(
+            "collective_bytes_per_step", 0),
+        "peak_hbm_bytes": donation.get("peak_hbm_bytes", 0),
     }
 
 

@@ -8,7 +8,7 @@ programs, and the CLIs map deterministic errors to the documented rc
 catalogue. Each was one careless PR away from silently regressing step time
 or pod determinism.
 
-This package turns them into three static/runtime passes over the *traced
+This package turns them into static/runtime passes over the *traced
 program* (jaxpr / compiled HLO), not just the source text:
 
 - `jaxpr_audit`  — a registry of every jitted step factory, lowered on
@@ -18,6 +18,15 @@ program* (jaxpr / compiled HLO), not just the source text:
 - `lint`         — AST passes: host-sync idioms inside step factories
   (`.item()`, `print`, `np.asarray`, `time.time()`, `float(tracer)`) and
   CLI exit sites outside the documented rc catalogue.
+- `sharding_audit` — each program compiled on the composed multi-device
+  audit meshes (dp 2×1, dp×tp 2×2): collective inventory (kind / mesh-axis
+  / payload bytes vs per-cell comms policies, incl. the dp gradient
+  all-reduce floor), sharding table (ZeRO / implicit-resharding
+  detectors), and the `memory_analysis()` budget.
+- `baseline`     — the sharded records persisted into the committed
+  `analysis/baselines.json`; `cli.analyze --diff-baseline` turns drift
+  beyond tolerance (new kind, payload/peak-HBM growth, sharding
+  downgrade, donation regression) into findings.
 - `compile_sentinel` — a runtime recompile guard armed after warmup by the
   trainer and the serving engine; any steady-state compile is counted and
   logged with the offending signature (optionally fatal).
@@ -37,7 +46,8 @@ from typing import Any, Dict
 class Finding:
     """One invariant violation. `check` names the detector (donation,
     callback, collectives, uint8-epilogue, host-sync, rc-catalogue,
-    recompile), `where` locates it (registry entry or file:line), and
+    recompile, comms, sharding, resharding, baseline), `where` locates it
+    (registry entry, program@mesh cell, or file:line), and
     `evidence` carries the machine-readable payload (byte counts, primitive
     names, signatures) the CLI prints and tests assert on."""
 

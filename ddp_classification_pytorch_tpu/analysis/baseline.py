@@ -1,0 +1,184 @@
+"""Checked-in program baselines: the traced program's perf shape as a diff.
+
+`sharding_audit.audit_sharded_registry` reduces every (program, mesh) cell
+to a record — collective inventory, payload bytes/step, peak HBM, sharding
+digest, donation coverage. This module persists those records into the
+committed `analysis/baselines.json` and diffs a fresh audit against them,
+so a PR that adds an all-gather to the hot step, grows the gradient
+payload, replicates a buffer that used to shard, or drops donation
+coverage turns CI red (`cli.analyze --diff-baseline`, wired into
+scripts/lint.sh) — the CPU-side regression fence the MFU push needs
+between TPU windows.
+
+Drift classes and their tolerances (DEFAULT_TOLERANCES):
+
+- **new collective kind** — zero tolerance: a kind absent from the
+  baseline is new cross-device traffic, whatever its size.
+- **payload growth** — `payload_growth_pct` (10%): collective bytes/step
+  above baseline by more than this is a bigger per-step wire bill.
+- **peak HBM growth** — `peak_hbm_growth_pct` (10%): headroom is the
+  difference between a batch size that fits and an OOM at flagship scale.
+- **sharding downgrade** — zero tolerance: a leaf in the baseline's
+  sharded digest that is now replicated (or sharded differently) changed
+  the program's layout contract.
+- **donation regression** — zero tolerance below the baseline's coverage.
+
+Shrinkage (fewer bytes, lower peak) is NOT a finding — it is the
+improvement the fence exists to protect; regenerate the baseline to bank
+it (`--update-baseline`, runbook in docs/analysis.md).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from typing import Any, Dict, List, Optional
+
+from . import Finding
+
+DEFAULT_BASELINE_PATH = os.path.join(os.path.dirname(__file__),
+                                     "baselines.json")
+
+DEFAULT_TOLERANCES: Dict[str, float] = {
+    "payload_growth_pct": 10.0,
+    "peak_hbm_growth_pct": 10.0,
+}
+
+
+def load_baseline(path: Optional[str] = None) -> Dict[str, Any]:
+    path = path or DEFAULT_BASELINE_PATH
+    if not os.path.exists(path):
+        raise FileNotFoundError(
+            f"no program baseline at {path} — generate one with "
+            "`python -m ddp_classification_pytorch_tpu.cli.analyze "
+            "--update-baseline` and commit it")
+    with open(path) as f:
+        return json.load(f)
+
+
+def write_baseline(records: Dict[str, Any], path: Optional[str] = None,
+                   context: Optional[Dict[str, Any]] = None) -> str:
+    """Persist audit records with a provenance header (tool, jax version,
+    platform/device count, audit config, regeneration runbook pointer).
+    Deterministic layout (sorted keys, stable indent) so the committed
+    diff shows exactly the drifted fields."""
+    import jax
+
+    path = path or DEFAULT_BASELINE_PATH
+    payload = {
+        "_provenance": {
+            "generated_by": "python -m ddp_classification_pytorch_tpu."
+                            "cli.analyze --update-baseline",
+            "generated_at": time.strftime("%Y-%m-%d", time.gmtime()),
+            "jax": jax.__version__,
+            "platform": jax.devices()[0].platform,
+            "device_count": jax.device_count(),
+            "config": dict(context or {}),
+            "note": "Regenerate ONLY for an intentional program change "
+                    "(new sharding rule, optimizer, or step structure) and "
+                    "review the diff as part of the PR — see "
+                    "docs/analysis.md '--update-baseline runbook'.",
+        },
+        "tolerances": dict(DEFAULT_TOLERANCES),
+        "programs": records,
+    }
+    with open(path, "w") as f:
+        json.dump(payload, f, indent=2, sort_keys=True)
+        f.write("\n")
+    return path
+
+
+def _pct_over(current: float, base: float) -> float:
+    if base <= 0:
+        return float("inf") if current > 0 else 0.0
+    return (current - base) / base * 100.0
+
+
+def diff_baseline(records: Dict[str, Any], baseline: Dict[str, Any],
+                  tolerances: Optional[Dict[str, float]] = None,
+                  subset: bool = False) -> List[Finding]:
+    """Fresh audit records vs the committed baseline → findings for every
+    drift beyond tolerance, each attributed to its (program@mesh, field).
+
+    `subset=True` compares only the programs present in `records` (the
+    tier-1 tests audit a lean cell subset); the default also flags
+    baseline programs the fresh audit no longer produced — a silently
+    dropped program is drift too."""
+    tol = {**DEFAULT_TOLERANCES, **(baseline.get("tolerances") or {}),
+           **(tolerances or {})}
+    base_programs = baseline.get("programs", {})
+    findings: List[Finding] = []
+
+    for key, rec in sorted(records.items()):
+        base = base_programs.get(key)
+        if base is None:
+            findings.append(Finding(
+                "baseline", key,
+                "program not in the committed baseline — a new audited "
+                "program must be banked with --update-baseline (and the "
+                "diff reviewed) before CI can fence it"))
+            continue
+
+        new_kinds = sorted(set(rec.get("collectives", {}))
+                           - set(base.get("collectives", {})))
+        if new_kinds:
+            findings.append(Finding(
+                "baseline", key,
+                f"new collective kind(s) vs baseline: {new_kinds} — "
+                "cross-device traffic the step did not have when the "
+                "baseline was banked",
+                {"new_kinds": new_kinds}))
+
+        cur_b = rec.get("collective_bytes_per_step", 0) or 0
+        base_b = base.get("collective_bytes_per_step", 0) or 0
+        growth = _pct_over(cur_b, base_b)
+        if growth > tol["payload_growth_pct"]:
+            findings.append(Finding(
+                "baseline", key,
+                f"collective payload grew {growth:.1f}% "
+                f"({base_b:,} → {cur_b:,} B/step), tolerance "
+                f"{tol['payload_growth_pct']}%",
+                {"base": base_b, "current": cur_b, "growth_pct":
+                 round(growth, 1)}))
+
+        cur_p = rec.get("peak_hbm_bytes", 0) or 0
+        base_p = base.get("peak_hbm_bytes", 0) or 0
+        growth = _pct_over(cur_p, base_p)
+        if growth > tol["peak_hbm_growth_pct"]:
+            findings.append(Finding(
+                "baseline", key,
+                f"peak HBM grew {growth:.1f}% ({base_p:,} → {cur_p:,} B), "
+                f"tolerance {tol['peak_hbm_growth_pct']}%",
+                {"base": base_p, "current": cur_p, "growth_pct":
+                 round(growth, 1)}))
+
+        cur_sh = rec.get("sharded_leaves", {})
+        for path, spec in sorted(base.get("sharded_leaves", {}).items()):
+            got = cur_sh.get(path)
+            if got != spec:
+                findings.append(Finding(
+                    "baseline", key,
+                    f"sharding downgrade: `{path}` was {spec} in the "
+                    f"baseline, now {got or 'replicated'} — the layout "
+                    "contract changed (replication where a shard was)",
+                    {"path": path, "base": spec, "current": got}))
+
+        base_cov = base.get("donation_coverage")
+        cur_cov = rec.get("donation_coverage")
+        if base_cov is not None and (cur_cov is None or cur_cov < base_cov):
+            findings.append(Finding(
+                "baseline", key,
+                f"donation coverage regressed: {base_cov} → {cur_cov} — "
+                "state bytes that used to update in place now round-trip "
+                "HBM",
+                {"base": base_cov, "current": cur_cov}))
+
+    if not subset:
+        for key in sorted(set(base_programs) - set(records)):
+            findings.append(Finding(
+                "baseline", key,
+                "baseline program missing from the fresh audit — the "
+                "matrix shrank; if intentional, regenerate with "
+                "--update-baseline"))
+    return findings

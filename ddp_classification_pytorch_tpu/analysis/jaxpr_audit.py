@@ -331,6 +331,25 @@ class AuditContext:
             self._cache["mesh"] = meshlib.make_mesh()
         return self._cache["mesh"]
 
+    def composed_mesh(self, name: str):
+        """One of the composed audit meshes ('dp2' 2×1, 'dp2tp2' 2×2) from
+        `parallel.mesh.composed_audit_meshes`, cached. Raises with the fix
+        spelled out when the host exposes too few devices — the CLI
+        self-forces 8 virtual CPU devices for exactly this reason."""
+        key = f"mesh:{name}"
+        if key not in self._cache:
+            from ..parallel import mesh as meshlib
+
+            meshes = meshlib.composed_audit_meshes()
+            if name not in meshes:
+                raise RuntimeError(
+                    f"composed audit mesh '{name}' needs more devices than "
+                    f"the {jax.device_count()} visible — force a multi-device "
+                    "CPU backend (XLA_FLAGS=--xla_force_host_platform_"
+                    "device_count=8, set automatically by cli.analyze)")
+            self._cache[key] = meshes[name]
+        return self._cache[key]
+
     def state_for(self, workload: str):
         """(cfg, model, tx, state) for a workload preset, cached."""
         if workload not in self._cache:
@@ -352,6 +371,38 @@ class AuditContext:
 
     def valid(self):
         return jax.ShapeDtypeStruct((self.batch,), jnp.float32)
+
+
+def abstract_state(state, mesh):
+    """Re-home a concrete TrainState onto `mesh` as ShapeDtypeStructs
+    carrying that mesh's DECLARED shardings (params/opt under
+    `parallel.mesh`'s rules — so a >1 'model' axis actually class-shards
+    the head — batch_stats and step replicated, matching
+    train/state.py::create_train_state). Abstract avals are enough for
+    both `jax.make_jaxpr` and AOT `lower().compile()`, so one cached
+    state init serves every audited mesh without per-mesh init compiles."""
+    from ..parallel import mesh as meshlib
+
+    shardings = type(state)(
+        step=meshlib.replicated(mesh),
+        params=meshlib.param_shardings(state.params, mesh),
+        batch_stats=jax.tree_util.tree_map(
+            lambda _: meshlib.replicated(mesh), state.batch_stats),
+        opt_state=meshlib.opt_shardings(state.opt_state, mesh),
+    )
+    return jax.tree_util.tree_map(
+        lambda leaf, sh: jax.ShapeDtypeStruct(leaf.shape, leaf.dtype,
+                                              sharding=sh),
+        state, shardings)
+
+
+def batch_sharded(sds, mesh):
+    """A batch-input aval re-annotated with `mesh`'s leading-axis (data)
+    sharding — how the loader's global arrays actually arrive."""
+    from ..parallel.mesh import batch_sharding
+
+    return jax.ShapeDtypeStruct(sds.shape, sds.dtype,
+                                sharding=batch_sharding(mesh))
 
 
 def _build_train(ctx: AuditContext):
@@ -414,6 +465,57 @@ def _build_train_survivor(ctx: AuditContext):
     return fn, (state, ctx.images(), ctx.labels())
 
 
+# --- composed dp×tp builds (registry NOTE, PR 6): the same eval/serve
+# factories, but with state re-homed onto the 2×2 dp×tp audit mesh and
+# batch inputs data-sharded — so the SHARDED variants of these programs
+# (class-dim-split head, sharded batch) are donation/epilogue/collective-
+# audited too, not only the 1-device audit build. Trace-only entries
+# (no donate → no compile), so each costs one make_jaxpr.
+
+def _dp_tp_args(ctx: AuditContext, workload: str, *, labels: bool,
+                valid: bool):
+    mesh = ctx.composed_mesh("dp2tp2")
+    _, _, _, state = ctx.state_for(workload)
+    args = [abstract_state(state, mesh), batch_sharded(ctx.images(), mesh)]
+    if labels:
+        args.append(batch_sharded(ctx.labels(), mesh))
+    if valid:
+        args.append(batch_sharded(ctx.valid(), mesh))
+    return mesh, tuple(args)
+
+
+def _build_eval_dp_tp(ctx: AuditContext):
+    from ..train.steps import make_eval_step
+
+    cfg, model, _, _ = ctx.state_for("baseline")
+    mesh, args = _dp_tp_args(ctx, "baseline", labels=True, valid=True)
+    return make_eval_step(cfg, model, mesh=mesh), args
+
+
+def _build_nested_eval_dp_tp(ctx: AuditContext):
+    from ..train.steps import make_nested_eval_step
+
+    cfg, model, _, _ = ctx.state_for("nested")
+    _, args = _dp_tp_args(ctx, "nested", labels=True, valid=True)
+    return make_nested_eval_step(cfg, model), args
+
+
+def _build_plc_predict_dp_tp(ctx: AuditContext):
+    from ..train.steps import make_predict_step
+
+    cfg, model, _, _ = ctx.state_for("baseline")
+    _, args = _dp_tp_args(ctx, "baseline", labels=False, valid=False)
+    return make_predict_step(cfg, model), args
+
+
+def _build_topk_predict_dp_tp(ctx: AuditContext):
+    from ..train.steps import make_topk_predict_step
+
+    cfg, model, _, _ = ctx.state_for("baseline")
+    _, args = _dp_tp_args(ctx, "baseline", labels=False, valid=False)
+    return make_topk_predict_step(cfg, model, k=3), args
+
+
 def _build_shard_map_train(ctx: AuditContext):
     from ..parallel.collectives import build_ddp_model, make_shard_map_train_step
     from ..train.schedule import build_optimizer
@@ -467,6 +569,34 @@ def build_registry() -> List[StepSpec]:
             name="nested_eval_step",
             factory="ddp_classification_pytorch_tpu.train.steps:make_nested_eval_step",
             build=_build_nested_eval,
+            no_donate_reason=_EVAL_NO_DONATE,
+            uint8_input=True,
+        ),
+        StepSpec(
+            name="plc_predict_dp_tp",
+            factory="ddp_classification_pytorch_tpu.train.steps:make_predict_step",
+            build=_build_plc_predict_dp_tp,
+            no_donate_reason=_EVAL_NO_DONATE,
+            uint8_input=True,
+        ),
+        StepSpec(
+            name="topk_predict_dp_tp",
+            factory="ddp_classification_pytorch_tpu.train.steps:make_topk_predict_step",
+            build=_build_topk_predict_dp_tp,
+            no_donate_reason=_EVAL_NO_DONATE,
+            uint8_input=True,
+        ),
+        StepSpec(
+            name="eval_step_dp_tp",
+            factory="ddp_classification_pytorch_tpu.train.steps:make_eval_step",
+            build=_build_eval_dp_tp,
+            no_donate_reason=_EVAL_NO_DONATE,
+            uint8_input=True,
+        ),
+        StepSpec(
+            name="nested_eval_step_dp_tp",
+            factory="ddp_classification_pytorch_tpu.train.steps:make_nested_eval_step",
+            build=_build_nested_eval_dp_tp,
             no_donate_reason=_EVAL_NO_DONATE,
             uint8_input=True,
         ),

@@ -1,0 +1,616 @@
+"""SPMD sharding & communication audit of the compiled step programs.
+
+The jaxpr audit (jaxpr_audit.py) proves donation, callback-freedom, and the
+uint8 epilogue — but says nothing about the properties that decide step
+time on a pod: which collectives GSPMD actually inserted, how big their
+payloads are, whether params/optimizer state ended up replicated or
+sharded, and peak HBM. This pass lowers the registry's programs on the
+composed multi-device audit meshes (`parallel.mesh.composed_audit_meshes`:
+dp-only 2×1 and dp×tp 2×2) and extracts three evidence families from each
+compiled executable:
+
+- **collective inventory** — every `all-reduce` / `all-gather` /
+  `reduce-scatter` / `collective-permute` / `all-to-all` op in the HLO
+  text, with per-device payload bytes per step and the MESH AXIS it runs
+  over (attributed by matching `replica_groups` — both the explicit
+  `{{0,2},{1,3}}` and the iota `[2,2]<=[2,2]T(1,0)` forms — against the
+  partitions each mesh axis induces on the device ordinals).
+- **sharding table** — the executable's `input_shardings` (post-GSPMD
+  truth, not the request) per input leaf, flagging large buffers
+  replicated across the data axis (the ZeRO opportunity/regression
+  detector) and implicit weight resharding (a big all-gather inside the
+  step — the accidental MFU eater).
+- **memory budget** — argument/output/temp/alias bytes from
+  `memory_analysis()` and the derived `peak_hbm_bytes`
+  (arg + out + temp − alias), generalizing the donation evidence.
+
+Per-program **comms policies** turn the inventory into findings: the dp
+train step must carry the gradient all-reduce set (data-axis all-reduce
+bytes ≥ the parameter bytes) and NOTHING else; eval/serve programs stay
+collective-free up to the scalar metric reductions (per-op payload under
+`SMALL_COLLECTIVE_BYTES`) their device-side accumulation design implies.
+
+`analysis/baseline.py` persists the records per (program, mesh, config)
+into the checked-in `analysis/baselines.json`; `cli.analyze
+--diff-baseline` turns drift beyond tolerances into rc 1 findings.
+
+Everything here is CPU-pinned host-side analysis — payloads and shardings
+are topology properties of the lowered program, identical on the TPU the
+program will actually run on (per-device local shapes scale with the real
+mesh, which is why the audit meshes are FIXED 2×1/2×2 compositions: the
+baseline must not depend on the host's device count).
+"""
+
+from __future__ import annotations
+
+import re
+import warnings
+from dataclasses import dataclass
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+
+from . import Finding
+from .jaxpr_audit import (
+    AuditContext,
+    _DTYPE_BYTES,
+    abstract_state,
+    batch_sharded,
+)
+
+# collective op kinds extracted from HLO (async `-start` halves carry the
+# payload; `-done` is payload-free and deliberately NOT matched below)
+COLLECTIVE_KINDS = ("all-reduce", "all-gather", "reduce-scatter",
+                    "collective-permute", "all-to-all")
+
+# per-op payload allowed in a "collective-free" program: the scalar metric
+# reductions (loss/topk sums over the sharded batch) that device-side eval
+# accumulation implies. Calibrated ~8× above the worst legitimate op
+# observed (nested-eval's top-k vectors, ≤2 KiB) and far below any
+# weight/activation payload at real scale.
+SMALL_COLLECTIVE_BYTES = 16 * 1024
+
+# an all-gather at/above this per-op payload is weight (not control)
+# traffic: implicit resharding of a parameter inside the step
+RESHARD_BYTES = 256 * 1024
+
+# ZeRO detector: an input buffer this large replicated across a >1 data
+# axis is optimizer/param state the data axis could shard. Above the
+# audit config's largest legitimate leaf (~9.4 MB conv kernel) so the
+# repo audits clean until state sharding actually lands (ROADMAP).
+REPLICATED_BYTES = 16 * 1024 * 1024
+
+
+# ---------------------------------------------------------- HLO parsing --
+
+# `%name = <shape> all-reduce(...)` — shape is a single array literal or a
+# tuple of them; `(?:-start)?` admits the async halves, and the mandatory
+# `(` right after keeps `-done` ops (payload-free) out.
+_OP_RE = re.compile(
+    r"=\s*(?P<shape>\((?:[^()]|\([^)]*\))*\)|[a-z0-9]+\[[\d,]*\](?:\{[^}]*\})?)"
+    r"\s*(?P<kind>" + "|".join(COLLECTIVE_KINDS) + r")(?:-start)?\(",
+)
+_SHAPE_RE = re.compile(r"([a-z0-9]+)\[([\d,]*)\]")
+_GROUPS_RE = re.compile(
+    r"replica_groups=(?P<explicit>\{\{[\d, ]*(?:\},\{[\d, ]*)*\}\}"
+    r"|\{\})"
+    r"|replica_groups=\[(?P<gshape>[\d,]+)\]<=\[(?P<src>[\d,]+)\]"
+    r"(?:T\((?P<perm>[\d,]+)\))?"
+)
+
+
+def _payload_bytes(shape_str: str) -> int:
+    """Per-device payload of an HLO result shape (array or tuple literal);
+    unknown element types count 0 (conservative: never a false finding)."""
+    total = 0
+    for m in _SHAPE_RE.finditer(shape_str):
+        if m.group(1) not in _DTYPE_BYTES:
+            continue
+        n = 1
+        for d in m.group(2).split(","):
+            if d:
+                n *= int(d)
+        total += n * _DTYPE_BYTES[m.group(1)]
+    return total
+
+
+def parse_replica_groups(attr: str) -> Optional[frozenset]:
+    """`replica_groups=...` → frozenset of frozensets of device ordinals.
+
+    Handles both textual forms XLA emits: the explicit list
+    `{{0,2},{1,3}}` and the iota form `[2,2]<=[4]` /
+    `[2,2]<=[2,2]T(1,0)` (ids = arange(prod(src)).reshape(src)
+    .transpose(perm).reshape(groups, group_size)). Returns None when the
+    op carries no replica_groups attribute."""
+    m = _GROUPS_RE.search(attr)
+    if not m:
+        return None
+    if m.group("explicit") is not None:
+        # scan the raw literal: `\{...\}` matches each INNER group of
+        # `{{0,2},{1,3}}` (the outer braces never enclose a digit run);
+        # `{}` yields no non-empty group — the all-devices shorthand
+        groups = [g for g in re.findall(r"\{([\d, ]*)\}",
+                                        m.group("explicit")) if g.strip()]
+        if not groups:
+            return frozenset()
+        return frozenset(
+            frozenset(int(x) for x in g.replace(" ", "").split(",") if x)
+            for g in groups)
+    gshape = [int(x) for x in m.group("gshape").split(",")]
+    src = [int(x) for x in m.group("src").split(",")]
+    ids = np.arange(int(np.prod(src))).reshape(src)
+    if m.group("perm"):
+        ids = ids.transpose([int(x) for x in m.group("perm").split(",")])
+    ids = ids.reshape(gshape)
+    return frozenset(frozenset(int(x) for x in row) for row in ids)
+
+
+def _axis_groupings(mesh) -> Dict[str, frozenset]:
+    """Axis-subset label → the partition of device ordinals a collective
+    over exactly those mesh axes produces ('data', 'model', 'data+model',
+    …; the full-mesh subset also registers as 'all'). Ordinals index
+    `mesh.devices` in row-major order — the device-assignment order jit
+    uses — which is how HLO replica_groups number participants. Combined
+    subsets matter: with params replicated over BOTH axes of a dp×tp
+    mesh, XLA reduces gradients over the whole mesh in one op, so the
+    gradient all-reduce floor must count every partition that spans the
+    data axis."""
+    from itertools import combinations
+
+    shape = mesh.devices.shape
+    names = [str(n) for n in mesh.axis_names]
+    n = int(np.prod(shape))
+    idx = np.arange(n).reshape(shape)
+    out: Dict[str, frozenset] = {}
+    for r in range(1, len(names) + 1):
+        for axes in combinations(range(len(names)), r):
+            rest = [k for k in range(len(names)) if k not in axes]
+            rows = idx.transpose(rest + list(axes)).reshape(
+                -1, int(np.prod([shape[k] for k in axes])))
+            label = ("all" if len(axes) == len(names)
+                     else "+".join(names[k] for k in axes))
+            out[label] = frozenset(
+                frozenset(int(x) for x in row) for row in rows)
+    return out
+
+
+def _spans_data(label: str) -> bool:
+    """Whether an attribution label reduces over the data axis."""
+    from ..parallel.mesh import DATA_AXIS
+
+    return label == "all" or DATA_AXIS in label.split("+")
+
+
+def collective_inventory(hlo_text: str, mesh=None) -> Dict[str, Any]:
+    """Aggregate the compiled program's collectives per kind:
+    `{kinds: {kind: {count, bytes, max_op_bytes, axes: {axis: bytes}}},
+    total_bytes}`. Bytes are per-device payload per step, summed over ops
+    (CPU XLA does not combine the per-gradient all-reduces, so counts are
+    high and per-op payloads small — the BYTES are the invariant).
+    Axis attribution needs `mesh`; unattributable groups land on
+    'unknown' (never silently dropped)."""
+    axis_parts = _axis_groupings(mesh) if mesh is not None else {}
+    kinds: Dict[str, Dict[str, Any]] = {}
+    total = 0
+    for line in hlo_text.splitlines():
+        m = _OP_RE.search(line)
+        if not m:
+            continue
+        kind = m.group("kind")
+        payload = _payload_bytes(m.group("shape"))
+        groups = parse_replica_groups(line)
+        axis = "unknown"
+        if groups is not None:
+            if not groups:
+                # HLO shorthand: replica_groups={} = every device, one group
+                axis = "all"
+            elif all(len(g) <= 1 for g in groups):
+                axis = "none"  # degenerate: no cross-device traffic
+            else:
+                for name, part in axis_parts.items():
+                    if groups == part:
+                        axis = name
+                        break
+        rec = kinds.setdefault(kind, {"count": 0, "bytes": 0,
+                                      "max_op_bytes": 0, "axes": {}})
+        rec["count"] += 1
+        rec["bytes"] += payload
+        rec["max_op_bytes"] = max(rec["max_op_bytes"], payload)
+        rec["axes"][axis] = rec["axes"].get(axis, 0) + payload
+        total += payload
+    return {"kinds": kinds, "total_bytes": total}
+
+
+def memory_budget(compiled) -> Dict[str, int]:
+    """The executable's memory shape from `memory_analysis()`:
+    argument/output/temp/alias bytes plus the derived peak
+    (arg + out + temp − alias: donated-aliased buffers are counted once)."""
+    ma = compiled.memory_analysis()
+    arg = int(ma.argument_size_in_bytes)
+    out = int(ma.output_size_in_bytes)
+    temp = int(ma.temp_size_in_bytes)
+    alias = int(ma.alias_size_in_bytes)
+    return {"arg_bytes": arg, "out_bytes": out, "temp_bytes": temp,
+            "alias_bytes": alias,
+            "peak_hbm_bytes": arg + out + temp - alias}
+
+
+# ------------------------------------------------------- sharding table --
+
+def _spec_str(sharding) -> str:
+    spec = getattr(sharding, "spec", None)
+    return str(spec) if spec is not None else str(sharding)
+
+
+def _uses_axis(sharding, axis: str) -> bool:
+    spec = getattr(sharding, "spec", None) or ()
+    for entry in spec:
+        names = entry if isinstance(entry, tuple) else (entry,)
+        if axis in names:
+            return True
+    return False
+
+
+def _local_leaf_bytes(leaf) -> int:
+    """Per-device bytes of one arg leaf: the sharded LOCAL shard when the
+    leaf (concrete array or annotated SDS) carries a NamedSharding, else
+    the global shape."""
+    shape = tuple(leaf.shape)
+    sh = getattr(leaf, "sharding", None)
+    if sh is not None and hasattr(sh, "shard_shape"):
+        try:
+            shape = sh.shard_shape(shape)
+        except Exception:
+            pass
+    return int(np.prod(shape, dtype=np.int64)) * np.dtype(leaf.dtype).itemsize
+
+
+def sharding_table(compiled, args: Sequence[Any]) -> List[Dict[str, Any]]:
+    """One row per input leaf: `{path, shape, dtype, bytes, spec}` with
+    `spec` read from the EXECUTABLE's input_shardings (what GSPMD settled
+    on), `bytes` the leaf's global size. Row order is the args pytree's
+    leaf order — identical between the two trees by construction."""
+    flat_args = jax.tree_util.tree_flatten_with_path(tuple(args))[0]
+    in_shardings = jax.tree_util.tree_leaves(
+        compiled.input_shardings[0],
+        is_leaf=lambda x: hasattr(x, "spec") or x is None)
+    rows = []
+    for (path, leaf), sh in zip(flat_args, in_shardings):
+        rows.append({
+            "path": jax.tree_util.keystr(path),
+            "shape": tuple(leaf.shape),
+            "dtype": str(np.dtype(leaf.dtype)),
+            "bytes": int(np.prod(leaf.shape, dtype=np.int64))
+            * np.dtype(leaf.dtype).itemsize,
+            "spec": _spec_str(sh),
+            "_sharding": sh,
+        })
+    return rows
+
+
+def audit_sharding_table(rows: List[Dict[str, Any]], mesh, where: str,
+                         replicated_threshold: int = REPLICATED_BYTES
+                         ) -> List[Finding]:
+    """The ZeRO detector: a large input buffer replicated across a >1 data
+    axis is state the data axis could shard — a silent sharding downgrade
+    once ZeRO-style sharding lands, an unclaimed HBM win until then."""
+    from ..parallel.mesh import DATA_AXIS
+
+    findings: List[Finding] = []
+    if dict(mesh.shape).get(DATA_AXIS, 1) <= 1:
+        return findings
+    for row in rows:
+        if (row["bytes"] >= replicated_threshold
+                and not _uses_axis(row["_sharding"], DATA_AXIS)):
+            findings.append(Finding(
+                "sharding", where,
+                f"{row['bytes']:,} B buffer `{row['path']}` "
+                f"{row['shape']} is replicated across the "
+                f"{dict(mesh.shape)[DATA_AXIS]}-way data axis "
+                f"(spec {row['spec']}) — ZeRO-shardable state burning HBM "
+                "on every data replica",
+                {"path": row["path"], "bytes": row["bytes"],
+                 "spec": row["spec"]}))
+    return findings
+
+
+# ------------------------------------------------------- comms policies --
+
+@dataclass(frozen=True)
+class CommsPolicy:
+    """What a program's compiled collectives are allowed to look like.
+
+    `allowed_kinds` beyond which any op is a finding; `small_bytes` caps
+    the PER-OP payload of allowed kinds (0 = uncapped — the train step's
+    gradient all-reduces are as big as the gradients); and
+    `require_grad_allreduce` asserts the dp gradient set is PRESENT
+    (data-axis all-reduce bytes ≥ the program's parameter bytes — the
+    detector for a train step that silently stopped averaging)."""
+
+    allowed_kinds: Tuple[str, ...]
+    small_bytes: int = 0
+    require_grad_allreduce: bool = False
+
+
+TRAIN_COMMS = CommsPolicy(allowed_kinds=("all-reduce",),
+                          require_grad_allreduce=True)
+# eval/serve: "collective-free" up to control-sized payloads — the scalar
+# metric reductions (all-reduce) and top-k's per-shard candidate exchange
+# (all-gather, a few hundred bytes); the per-op cap is what keeps data and
+# weights out, and the resharding detector independently catches
+# weight-sized all-gathers
+EVAL_COMMS = CommsPolicy(allowed_kinds=("all-reduce", "all-gather"),
+                         small_bytes=SMALL_COLLECTIVE_BYTES)
+
+
+def audit_collectives(inventory: Dict[str, Any], policy: CommsPolicy,
+                      where: str, min_grad_bytes: int = 0) -> List[Finding]:
+    """Inventory × policy → findings: disallowed kinds, oversized ops in
+    allowed kinds, a missing gradient all-reduce set, and (independent of
+    policy) weight-sized all-gathers — the implicit-resharding detector."""
+    findings: List[Finding] = []
+    kinds = inventory["kinds"]
+    for kind, rec in sorted(kinds.items()):
+        if kind not in policy.allowed_kinds:
+            findings.append(Finding(
+                "comms", where,
+                f"`{kind}` in a program whose policy allows only "
+                f"{list(policy.allowed_kinds)}: {rec['count']} op(s), "
+                f"{rec['bytes']:,} B/step over axes "
+                f"{sorted(rec['axes'])} — new cross-device traffic in "
+                "the step",
+                {"kind": kind, **{k: v for k, v in rec.items()}}))
+        elif policy.small_bytes and rec["max_op_bytes"] > policy.small_bytes:
+            findings.append(Finding(
+                "comms", where,
+                f"`{kind}` payload {rec['max_op_bytes']:,} B exceeds the "
+                f"{policy.small_bytes:,} B scalar-reduction allowance for "
+                "a collective-free program — this is data, not a metric "
+                "sum (device-side eval accumulation ships counts only)",
+                {"kind": kind, **{k: v for k, v in rec.items()}}))
+    ag = kinds.get("all-gather")
+    if ag and ag["max_op_bytes"] >= RESHARD_BYTES:
+        findings.append(Finding(
+            "resharding", where,
+            f"all-gather of {ag['max_op_bytes']:,} B inside the step — "
+            "weight-sized, i.e. a parameter is implicitly resharded "
+            "(gathered) every step instead of being laid out where it is "
+            "consumed; pin it with in_shardings/with_sharding_constraint",
+            {k: v for k, v in ag.items()}))
+    if policy.require_grad_allreduce and min_grad_bytes > 0:
+        got = sum(b for label, b in
+                  kinds.get("all-reduce", {}).get("axes", {}).items()
+                  if _spans_data(label))
+        if got < min_grad_bytes:
+            findings.append(Finding(
+                "comms", where,
+                f"all-reduces spanning the data axis carry {got:,} B/step "
+                f"but the program's parameters total {min_grad_bytes:,} B — the "
+                "gradient all-reduce set is missing or truncated (replicas "
+                "are silently training on local gradients)",
+                {"data_axis_allreduce_bytes": got,
+                 "param_bytes": min_grad_bytes}))
+    return findings
+
+
+# ------------------------------------------------- compile + evidence --
+
+def _unaliased_from_warnings(caught) -> List[Dict[str, Any]]:
+    from .jaxpr_audit import _shape_bytes
+
+    unaliased: List[Dict[str, Any]] = []
+    for w in caught:
+        msg = str(w.message)
+        if "donated" not in msg.lower():
+            continue
+        for shape in re.findall(r"[a-z0-9]+\[[\d,]*\](?:\{[\d,]*\})?", msg):
+            unaliased.append({"buffer": shape.split("{")[0],
+                              "bytes": _shape_bytes(shape)})
+    return unaliased
+
+
+def _compile_with_evidence(jitted_fn, args: Sequence[Any],
+                           donated_argnums: Sequence[int] = (),
+                           mesh=None) -> Tuple[Dict[str, Any], Any]:
+    """ONE AOT lower+compile yielding (evidence, compiled). Evidence
+    carries the donation fields (donated bytes are per-device LOCAL under
+    a sharded mesh — `shard_shape` — matching the per-device alias table
+    memory_analysis reports), the collective inventory, and the memory
+    budget — the superset bench.py and the sharded audit both ride, so
+    neither pays a second compile."""
+    with warnings.catch_warnings(record=True) as caught:
+        warnings.simplefilter("always")
+        compiled = jitted_fn.lower(*args).compile()
+    budget = memory_budget(compiled)
+    inventory = collective_inventory(compiled.as_text(), mesh)
+    donated = sum(_local_leaf_bytes(l) for i in donated_argnums
+                  for l in jax.tree_util.tree_leaves(args[i]))
+    coverage = (round(budget["alias_bytes"] / donated, 4)
+                if donated else None)
+    ev = {
+        "donated_bytes": donated,
+        "aliased_bytes": budget["alias_bytes"] if donated else None,
+        "donation_coverage": coverage,
+        "temp_bytes": budget["temp_bytes"],
+        "unaliased": _unaliased_from_warnings(caught) if donated else [],
+        "collective_bytes_per_step": inventory["total_bytes"],
+        "peak_hbm_bytes": budget["peak_hbm_bytes"],
+        "collectives": inventory,
+        "memory": budget,
+    }
+    return ev, compiled
+
+
+def step_comms_evidence(jitted_fn, args: Sequence[Any],
+                        donated_argnums: Sequence[int] = (0,),
+                        mesh=None) -> Dict[str, Any]:
+    """bench.py's evidence surface: the donation fields
+    (jaxpr_audit.donation_evidence-compatible) plus
+    `collective_bytes_per_step` and `peak_hbm_bytes`, from a single
+    compile in the warmup window (a persistent-cache hit on TPU)."""
+    ev, _ = _compile_with_evidence(jitted_fn, args, donated_argnums, mesh)
+    return ev
+
+
+# ------------------------------------------------------ the audit matrix --
+
+@dataclass
+class ShardedCase:
+    """One (program, mesh) cell of the sharded audit matrix."""
+
+    name: str          # registry program name
+    mesh_name: str     # composed_audit_meshes key: 'dp2' | 'dp2tp2'
+    build: Callable[[AuditContext, Any], Tuple[Any, Tuple[Any, ...]]]
+    policy: CommsPolicy
+    donate: Tuple[int, ...] = ()
+
+    @property
+    def key(self) -> str:
+        return f"{self.name}@{self.mesh_name}"
+
+
+def _case_train(ctx: AuditContext, mesh):
+    from ..train.steps import make_train_step
+
+    cfg, model, tx, state = ctx.state_for("baseline")
+    fn = make_train_step(cfg, model, tx, mesh=mesh)
+    return fn, (abstract_state(state, mesh),
+                batch_sharded(ctx.images(), mesh),
+                batch_sharded(ctx.labels(), mesh))
+
+
+def _case_eval(ctx: AuditContext, mesh):
+    from ..train.steps import make_eval_step
+
+    cfg, model, _, state = ctx.state_for("baseline")
+    fn = make_eval_step(cfg, model, mesh=mesh)
+    return fn, (abstract_state(state, mesh),
+                batch_sharded(ctx.images(), mesh),
+                batch_sharded(ctx.labels(), mesh),
+                batch_sharded(ctx.valid(), mesh))
+
+
+def _case_nested_eval(ctx: AuditContext, mesh):
+    from ..train.steps import make_nested_eval_step
+
+    cfg, model, _, state = ctx.state_for("nested")
+    fn = make_nested_eval_step(cfg, model)
+    return fn, (abstract_state(state, mesh),
+                batch_sharded(ctx.images(), mesh),
+                batch_sharded(ctx.labels(), mesh),
+                batch_sharded(ctx.valid(), mesh))
+
+
+def _case_plc_predict(ctx: AuditContext, mesh):
+    from ..train.steps import make_predict_step
+
+    cfg, model, _, state = ctx.state_for("baseline")
+    return make_predict_step(cfg, model), (
+        abstract_state(state, mesh), batch_sharded(ctx.images(), mesh))
+
+
+def _case_topk_predict(ctx: AuditContext, mesh):
+    from ..train.steps import make_topk_predict_step
+
+    cfg, model, _, state = ctx.state_for("baseline")
+    return make_topk_predict_step(cfg, model, k=3), (
+        abstract_state(state, mesh), batch_sharded(ctx.images(), mesh))
+
+
+def sharded_registry() -> List[ShardedCase]:
+    """The audited (program, mesh) matrix. Train + the serve hot path
+    (topk) and eval run on BOTH composed meshes; the remaining eval-family
+    programs on the composed dp×tp mesh (their dp-only structure is the
+    dp2 eval cell's, minus the class-dim split). Ordered cheap-first so a
+    red CLI run fails fast; each cell is one lower+compile."""
+    return [
+        ShardedCase("plc_predict", "dp2tp2", _case_plc_predict, EVAL_COMMS),
+        ShardedCase("topk_predict", "dp2", _case_topk_predict, EVAL_COMMS),
+        ShardedCase("topk_predict", "dp2tp2", _case_topk_predict, EVAL_COMMS),
+        ShardedCase("eval_step", "dp2", _case_eval, EVAL_COMMS),
+        ShardedCase("eval_step", "dp2tp2", _case_eval, EVAL_COMMS),
+        ShardedCase("nested_eval_step", "dp2tp2", _case_nested_eval,
+                    EVAL_COMMS),
+        ShardedCase("train_step", "dp2", _case_train, TRAIN_COMMS,
+                    donate=(0,)),
+        ShardedCase("train_step", "dp2tp2", _case_train, TRAIN_COMMS,
+                    donate=(0,)),
+    ]
+
+
+def _param_bytes(ctx: AuditContext, workload: str = "baseline") -> int:
+    _, _, _, state = ctx.state_for(workload)
+    return sum(int(np.prod(l.shape, dtype=np.int64))
+               * np.dtype(l.dtype).itemsize
+               for l in jax.tree_util.tree_leaves(state.params))
+
+
+def audit_sharded_case(case: ShardedCase, ctx: AuditContext
+                       ) -> Tuple[List[Finding], Dict[str, Any]]:
+    """Compile one matrix cell and run every detector over it; returns
+    (findings, the baseline record for analysis/baselines.json)."""
+    mesh = ctx.composed_mesh(case.mesh_name)
+    fn, args = case.build(ctx, mesh)
+    ev, compiled = _compile_with_evidence(fn, args, case.donate, mesh)
+    where = case.key
+
+    findings = audit_collectives(
+        ev["collectives"], case.policy, where,
+        min_grad_bytes=_param_bytes(ctx) if
+        case.policy.require_grad_allreduce else 0)
+
+    rows = sharding_table(compiled, args)
+    findings += audit_sharding_table(rows, mesh, where)
+
+    if case.donate:
+        if ev["unaliased"] or (ev["donation_coverage"] is not None
+                               and ev["donation_coverage"] < 1.0):
+            per_buf = ", ".join(f"{u['buffer']}={u['bytes']}B"
+                                for u in ev["unaliased"]) or "n/a"
+            findings.append(Finding(
+                "donation", where,
+                f"donated inputs not fully aliased on this mesh: "
+                f"{ev['aliased_bytes']} of {ev['donated_bytes']} local "
+                f"bytes aliased (coverage {ev['donation_coverage']}); "
+                f"unaliased buffers: {per_buf}",
+                {k: ev[k] for k in ("donated_bytes", "aliased_bytes",
+                                    "donation_coverage", "unaliased")}))
+
+    record = {
+        "mesh": {str(k): int(v) for k, v in dict(mesh.shape).items()},
+        "collectives": {
+            kind: {"count": rec["count"], "bytes": rec["bytes"],
+                   "max_op_bytes": rec["max_op_bytes"],
+                   "axes": dict(sorted(rec["axes"].items()))}
+            for kind, rec in sorted(ev["collectives"]["kinds"].items())},
+        "collective_bytes_per_step": ev["collective_bytes_per_step"],
+        "peak_hbm_bytes": ev["peak_hbm_bytes"],
+        "temp_bytes": ev["memory"]["temp_bytes"],
+        "arg_bytes": ev["memory"]["arg_bytes"],
+        "out_bytes": ev["memory"]["out_bytes"],
+        "donation_coverage": ev["donation_coverage"],
+        # the non-replicated input leaves: the baseline's sharding digest —
+        # a leaf leaving this dict (or weakening its spec) is a downgrade
+        "sharded_leaves": {
+            r["path"]: r["spec"] for r in rows
+            if getattr(r["_sharding"], "spec", None)
+            and any(e is not None for e in r["_sharding"].spec)},
+        "n_input_leaves": len(rows),
+    }
+    return findings, record
+
+
+def audit_sharded_registry(ctx: Optional[AuditContext] = None,
+                           cases: Optional[List[ShardedCase]] = None
+                           ) -> Tuple[List[Finding], Dict[str, Any]]:
+    """Audit every matrix cell; returns (findings, {program@mesh: record})
+    — the records feed `analysis/baseline.py`."""
+    ctx = ctx or AuditContext()
+    records: Dict[str, Any] = {}
+    findings: List[Finding] = []
+    for case in (cases if cases is not None else sharded_registry()):
+        f, rec = audit_sharded_case(case, ctx)
+        findings += f
+        records[case.key] = rec
+    return findings, records

@@ -2,20 +2,29 @@
 
     python -m ddp_classification_pytorch_tpu.cli.analyze            # all passes
     python -m ddp_classification_pytorch_tpu.cli.analyze --passes lint
+    python -m ddp_classification_pytorch_tpu.cli.analyze --diff-baseline
+    python -m ddp_classification_pytorch_tpu.cli.analyze --update-baseline
     python -m ddp_classification_pytorch_tpu.cli.analyze --list     # inventory
 
 Exit discipline (same taxonomy as cli.train / cli.serve, docs/operations.md):
 
 - **rc 0** — every invariant holds (donation aliasing, callback-free hot
   paths, uint8 epilogue, collective-free eval/serve programs, host-sync-free
-  step factories, catalogued CLI exit codes);
+  step factories, catalogued CLI exit codes, sharding/comms policies, and —
+  under `--diff-baseline` — no drift beyond the committed baseline's
+  tolerances);
 - **rc 1** — findings: each printed as `[check] where: message`, machine
   copies via `--json`;
-- **rc 2** — usage/config error (unknown pass name, argparse errors).
+- **rc 2** — usage/config error (unknown pass name, argparse errors, a
+  backend that cannot host the composed audit meshes).
 
-The jaxpr pass lowers real step factories on a tiny synthetic config, so it
-runs in seconds on CPU; analysis never needs (or touches) an accelerator —
-the backend is pinned to CPU unless `--platform` overrides it. CI wrapper:
+The jaxpr/sharding passes lower real step factories on a tiny synthetic
+config, so they run in seconds on CPU; analysis never needs (or touches) an
+accelerator — the backend is pinned to CPU unless `--platform` overrides
+it, and a multi-device CPU topology is self-forced (XLA_FLAGS
+`--xla_force_host_platform_device_count=8`) so the composed 2×1/2×2 audit
+meshes exist on any host — a standalone `--diff-baseline` run matches the
+environment the committed baseline was generated in. CI wrapper:
 `scripts/lint.sh`; runbook for a red finding: docs/analysis.md.
 """
 
@@ -23,10 +32,15 @@ from __future__ import annotations
 
 import argparse
 import json
+import os
 import sys
 from typing import Optional, Sequence
 
-PASSES = ("jaxpr", "lint")
+PASSES = ("jaxpr", "lint", "sharding")
+
+# the composed audit meshes (dp2, dp2tp2) need ≥4 devices; on CPU we force
+# a virtual topology BEFORE backend init so baselines are host-independent
+_FORCED_CPU_DEVICES = 8
 
 
 def build_parser() -> argparse.ArgumentParser:
@@ -37,7 +51,9 @@ def build_parser() -> argparse.ArgumentParser:
     )
     p.add_argument("--passes", default=",".join(PASSES),
                    help="comma list of passes to run: jaxpr (trace/compile "
-                        "the step registry) and/or lint (AST passes); "
+                        "the step registry), lint (AST passes), sharding "
+                        "(compile the program×mesh matrix: collective "
+                        "inventory, sharding table, memory budget); "
                         "default: all")
     p.add_argument("--arch", default="resnet18",
                    help="backbone for the audit's tiny traced config "
@@ -59,6 +75,20 @@ def build_parser() -> argparse.ArgumentParser:
                    help="JAX platform for the jaxpr pass (default cpu: "
                         "analysis must never burn — or hang on — an "
                         "accelerator lease)")
+    p.add_argument("--baseline", default="",
+                   help="program-baseline JSON path (default: the "
+                        "checked-in analysis/baselines.json)")
+    p.add_argument("--diff-baseline", "--diff_baseline",
+                   dest="diff_baseline", action="store_true",
+                   help="diff the sharding pass's records against the "
+                        "committed baseline; drift beyond tolerances "
+                        "(new collective kind, payload/peak-HBM growth, "
+                        "sharding downgrade, donation regression) is rc 1")
+    p.add_argument("--update-baseline", "--update_baseline",
+                   dest="update_baseline", action="store_true",
+                   help="regenerate the baseline file from this run (with "
+                        "a provenance header) instead of diffing — commit "
+                        "the result; runbook in docs/analysis.md")
     return p
 
 
@@ -71,6 +101,22 @@ def main(argv: Optional[Sequence[str]] = None) -> None:
         print(f"[analyze] config error: unknown pass(es) {unknown or passes}; "
               f"choose from {list(PASSES)}", file=sys.stderr)
         raise SystemExit(2)
+    if (args.diff_baseline or args.update_baseline) and "sharding" not in passes:
+        passes = passes + ("sharding",)  # the baseline IS the sharding pass
+
+    if ("jaxpr" in passes or "sharding" in passes) and (
+            args.platform or "cpu") == "cpu":
+        # the registry's dp×tp entries and the sharded matrix need the
+        # composed 2×1/2×2 meshes: force a virtual multi-device CPU
+        # topology before the backend initializes (a no-op if the caller
+        # already forced one, e.g. the test suite's conftest), so a
+        # standalone `--diff-baseline` reproduces the committed baseline's
+        # environment on any host
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = (
+                flags + f" --xla_force_host_platform_device_count="
+                f"{_FORCED_CPU_DEVICES}").strip()
 
     from ..analysis.jaxpr_audit import build_registry
 
@@ -92,6 +138,15 @@ def main(argv: Optional[Sequence[str]] = None) -> None:
             print(f"  {'':22s} invariants: {', '.join(props)}")
         print("lint pass: host-sync idioms in the factories above; "
               "rc catalogue over cli/ exits (docs/operations.md matrix)")
+        from ..analysis.sharding_audit import sharded_registry
+
+        print("sharding pass (program × composed mesh matrix):")
+        for case in sharded_registry():
+            print(f"  {case.key:24s} policy: "
+                  f"allowed={list(case.policy.allowed_kinds)}"
+                  + (" + gradient all-reduce floor"
+                     if case.policy.require_grad_allreduce else
+                     f", per-op ≤ {case.policy.small_bytes}B"))
         return
 
     findings = []
@@ -103,16 +158,28 @@ def main(argv: Optional[Sequence[str]] = None) -> None:
         findings += lint_step_factories()
         findings += lint_rc_sites(paths=args.rc_paths)
 
-    if "jaxpr" in passes:
+    ctx = None
+    if "jaxpr" in passes or "sharding" in passes:
         import jax
 
         # analysis is host-side program inspection: pin CPU so a wedged TPU
         # tunnel can never hang the linter (cf. backend probing in cli.train)
         jax.config.update("jax_platforms", args.platform or "cpu")
-        from ..analysis.jaxpr_audit import AuditContext, audit_registry
+        from ..analysis.jaxpr_audit import AuditContext
 
         ctx = AuditContext(arch=args.arch, image_size=args.image_size,
                            num_classes=args.num_classes, batch=args.batchsize)
+        if "sharding" in passes and jax.device_count() < 4:
+            print(f"[analyze] config error: the sharding pass needs ≥4 "
+                  f"devices for the composed audit meshes, have "
+                  f"{jax.device_count()} (force more via XLA_FLAGS="
+                  "--xla_force_host_platform_device_count=8)",
+                  file=sys.stderr)
+            raise SystemExit(2)
+
+    if "jaxpr" in passes:
+        from ..analysis.jaxpr_audit import audit_registry
+
         jx_findings, specs = audit_registry(ctx)
         findings += jx_findings
         for spec in specs:
@@ -122,6 +189,36 @@ def main(argv: Optional[Sequence[str]] = None) -> None:
                 print(f"[analyze] {spec.name}: donated={don['donated_bytes']}B "
                       f"aliased={don['aliased_bytes']}B "
                       f"coverage={don['donation_coverage']}")
+
+    if "sharding" in passes:
+        from ..analysis import baseline as baselib
+        from ..analysis.sharding_audit import audit_sharded_registry
+
+        sh_findings, records = audit_sharded_registry(ctx)
+        findings += sh_findings
+        evidence["sharded"] = records
+        for key, rec in records.items():
+            print(f"[analyze] {key}: "
+                  f"collectives={rec['collective_bytes_per_step']}B/step "
+                  f"({'+'.join(sorted(rec['collectives'])) or 'none'}) "
+                  f"peak_hbm={rec['peak_hbm_bytes']}B"
+                  + (f" coverage={rec['donation_coverage']}"
+                     if rec["donation_coverage"] is not None else ""))
+        if args.update_baseline:
+            path = baselib.write_baseline(
+                records, args.baseline or None,
+                context={"arch": args.arch, "image_size": args.image_size,
+                         "num_classes": args.num_classes,
+                         "batch": args.batchsize})
+            print(f"[analyze] baseline written: {path} "
+                  f"({len(records)} programs) — review + commit the diff")
+        elif args.diff_baseline:
+            try:
+                base = baselib.load_baseline(args.baseline or None)
+            except FileNotFoundError as e:
+                print(f"[analyze] config error: {e}", file=sys.stderr)
+                raise SystemExit(2)
+            findings += baselib.diff_baseline(records, base)
 
     if args.json:
         with open(args.json, "w") as f:

@@ -94,6 +94,25 @@ def make_mesh(spec: MeshSpec = MeshSpec(), devices: Optional[Sequence[Any]] = No
     return Mesh(arr, axes)
 
 
+def composed_audit_meshes(devices: Optional[Sequence[Any]] = None
+                          ) -> "dict[str, Mesh]":
+    """The analysis passes' composed multi-device meshes, by name:
+    `dp2` (2×1, data-only) and `dp2tp2` (2×2, dp×tp), built over a
+    deterministic PREFIX of the device list so the audited program — and
+    therefore the checked-in baseline (analysis/baselines.json) — is
+    identical whether the host exposes 4, 8, or 256 devices. Meshes the
+    device count cannot cover are simply absent from the dict; callers
+    that require one (analysis/sharding_audit.py) raise their own error
+    naming the forced-device-count fix."""
+    devices = list(devices) if devices is not None else jax.devices()
+    out: "dict[str, Mesh]" = {}
+    if len(devices) >= 2:
+        out["dp2"] = make_mesh(MeshSpec(2, 1), devices=devices[:2])
+    if len(devices) >= 4:
+        out["dp2tp2"] = make_mesh(MeshSpec(2, 2), devices=devices[:4])
+    return out
+
+
 def make_hybrid_mesh(spec: MeshSpec = MeshSpec(), *,
                      dcn_data_parallel: int = 0) -> Mesh:
     """Multi-slice mesh: data parallelism split across DCN-connected slices,

@@ -302,26 +302,33 @@ class ServingEngine:
         pre = self.compiled_programs()
         sentinel = CompileSentinel(tag="serve")
         sentinel.arm()
-        h = self.image_size
-        for b in self.buckets:
-            scores, _ = self._predict(
-                self._state, np.zeros((b, h, h, 3), self._np_dtype))
-            np.asarray(scores)  # block: compile belongs to warmup, not a request
-        events = sentinel.take()
-        pname = getattr(self._predict, "__name__", "")
-        n_new = (len([e for e in events if e.name == pname]) if pname
-                 else len(events))
-        if pre == 0 and n_new != len(self.buckets):
-            raise RuntimeError(
-                f"serve warmup compiled {n_new} predict programs, expected "
-                f"exactly {len(self.buckets)} (one per bucket "
-                f"{list(self.buckets)}) — the bucket→compile contract is "
-                "broken (docs/serving.md)")
-        if n_new > len(self.buckets):
-            raise RuntimeError(
-                f"serve warmup compiled {n_new} predict programs for "
-                f"{len(self.buckets)} buckets — more shapes than the bucket "
-                "set admits")
+        try:
+            h = self.image_size
+            for b in self.buckets:
+                scores, _ = self._predict(
+                    self._state, np.zeros((b, h, h, 3), self._np_dtype))
+                np.asarray(scores)  # block: compile belongs to warmup, not a request
+            events = sentinel.take()
+            pname = getattr(self._predict, "__name__", "")
+            n_new = (len([e for e in events if e.name == pname]) if pname
+                     else len(events))
+            if pre == 0 and n_new != len(self.buckets):
+                raise RuntimeError(
+                    f"serve warmup compiled {n_new} predict programs, expected "
+                    f"exactly {len(self.buckets)} (one per bucket "
+                    f"{list(self.buckets)}) — the bucket→compile contract is "
+                    "broken (docs/serving.md)")
+            if n_new > len(self.buckets):
+                raise RuntimeError(
+                    f"serve warmup compiled {n_new} predict programs for "
+                    f"{len(self.buckets)} buckets — more shapes than the bucket "
+                    "set admits")
+        except BaseException:
+            # a failed warmup must not leak an armed sentinel: the module
+            # refcount would keep jax's pxla logger at DEBUG (with
+            # propagation suppressed) for the rest of the process
+            sentinel.disarm()
+            raise
         self.compile_sentinel = sentinel  # armed: steady state begins
 
     def compiled_programs(self) -> Optional[int]:
@@ -374,14 +381,18 @@ class ServingEngine:
         # fatal_error is already recorded, the queued requests still answer.
         from ..analysis.compile_sentinel import SteadyStateRecompile
 
-        while True:
-            try:
-                if not self.process_once(timeout_s=0.0):
-                    break
-            except SteadyStateRecompile:
-                continue
-        if self.compile_sentinel is not None:
-            self.compile_sentinel.disarm()
+        try:
+            while True:
+                try:
+                    if not self.process_once(timeout_s=0.0):
+                        break
+                except SteadyStateRecompile:
+                    continue
+        finally:
+            # disarm is idempotent; the sentinel must not outlive the engine
+            # even when the inline flush raises
+            if self.compile_sentinel is not None:
+                self.compile_sentinel.disarm()
 
     def close(self) -> None:
         """Abort: stop the batcher and fail whatever is still queued
@@ -389,15 +400,17 @@ class ServingEngine:
         sibling."""
         self._closed = True
         self._stop.set()
-        if self._thread is not None:
-            self._thread.join(timeout=5.0)
-            self._thread = None
-        while True:
-            try:
-                req = self._q.get_nowait()
-            except queue.Empty:
-                break
-            if not req.future.done():
-                req.future.set_exception(EngineClosed("engine closed"))
-        if self.compile_sentinel is not None:
-            self.compile_sentinel.disarm()
+        try:
+            if self._thread is not None:
+                self._thread.join(timeout=5.0)
+                self._thread = None
+            while True:
+                try:
+                    req = self._q.get_nowait()
+                except queue.Empty:
+                    break
+                if not req.future.done():
+                    req.future.set_exception(EngineClosed("engine closed"))
+        finally:
+            if self.compile_sentinel is not None:
+                self.compile_sentinel.disarm()

@@ -506,58 +506,64 @@ class Trainer:
             init_m = self.evaluate()
             host0_print("[initial eval] " +
                         " ".join(f"{k}={v:.4f}" for k, v in init_m.items()))
-        for epoch in range(self.start_epoch, cfg.run.epochs):
+        try:
+            for epoch in range(self.start_epoch, cfg.run.epochs):
+                if self.compile_sentinel.armed:
+                    # epoch-boundary enforcement point: every host compiles
+                    # the same programs deterministically, so a strict raise
+                    # here lands on every pod member together (same rc 2)
+                    self.compile_sentinel.check(strict=cfg.run.strict_compile)
+                elif self._compile_sentinel_ready:
+                    # one full epoch cycle (train + eval + save) has
+                    # completed — arming any earlier would flag the
+                    # eval/gather first compiles; arming a cycle later (not
+                    # at save time) keeps the async checkpoint's background
+                    # compile out of scope
+                    self.compile_sentinel.arm()
+                    host0_print("[compile-sentinel] armed: steady state "
+                                f"begins (strict={cfg.run.strict_compile})")
+                t0 = time.time()
+                train_m = self.train_epoch(epoch, eta)
+                if self.fleet is not None:
+                    # epoch-boundary control collective (the ONLY per-epoch
+                    # pod sync): every host arrives here after the same
+                    # number of step collectives, exchanges abort intent,
+                    # and raises the same PodAbort rc when any host carries
+                    # one — a deterministic stop propagates within one epoch
+                    # instead of hanging peers (or tripping a misleading
+                    # heartbeat rc 7). Runs BEFORE eval/save so a diverged
+                    # epoch is neither evaluated nor checkpointed.
+                    self.fleet.check()
+                val_m = self.evaluate() if (epoch + 1) % cfg.run.eval_every == 0 else {}
+                last = {**train_m, **val_m, "epoch_time": time.time() - t0}
+                host0_print(
+                    f"[epoch {epoch}] " + " ".join(f"{k}={v:.4f}" for k, v in last.items())
+                )
+                if self.records is not None:
+                    self.records.log_epoch(epoch, **{k: v for k, v in last.items()})
+                if self.tb is not None:
+                    for k, v in last.items():
+                        group = "val" if k.startswith("val_") else "train"
+                        self.tb.add_scalar(f"{group}/{k}", v, epoch)
+                    self.tb.flush()
+                metric = val_m.get("val_top1")
+                self.ckpt.save(self.state, epoch, metric=metric,
+                               **({"best_k": val_m["best_k"]} if "best_k" in val_m else {}))
+                if val_m:
+                    self._compile_sentinel_ready = True  # arm at next epoch top
+            # the drain below can block on device_gets for an in-flight
+            # async save — that is backend work, so it stays under the
+            # heartbeat (writes are atomic, so a fire mid-drain cannot
+            # truncate; the supervisor's restart then auto-resumes into an
+            # already-complete run and exits cleanly)
+            self._heartbeat.touch()
             if self.compile_sentinel.armed:
-                # epoch-boundary enforcement point: every host compiles the
-                # same programs deterministically, so a strict raise here
-                # lands on every pod member together (same rc 2)
+                # surface the last epoch's recompiles before the release
                 self.compile_sentinel.check(strict=cfg.run.strict_compile)
-            elif self._compile_sentinel_ready:
-                # one full epoch cycle (train + eval + save) has completed —
-                # arming any earlier would flag the eval/gather first
-                # compiles; arming a cycle later (not at save time) keeps
-                # the async checkpoint's background compile out of scope
-                self.compile_sentinel.arm()
-                host0_print("[compile-sentinel] armed: steady state begins "
-                            f"(strict={cfg.run.strict_compile})")
-            t0 = time.time()
-            train_m = self.train_epoch(epoch, eta)
-            if self.fleet is not None:
-                # epoch-boundary control collective (the ONLY per-epoch
-                # pod sync): every host arrives here after the same number
-                # of step collectives, exchanges abort intent, and raises
-                # the same PodAbort rc when any host carries one — a
-                # deterministic stop propagates within one epoch instead
-                # of hanging peers (or tripping a misleading heartbeat
-                # rc 7). Runs BEFORE eval/save so a diverged epoch is
-                # neither evaluated nor checkpointed.
-                self.fleet.check()
-            val_m = self.evaluate() if (epoch + 1) % cfg.run.eval_every == 0 else {}
-            last = {**train_m, **val_m, "epoch_time": time.time() - t0}
-            host0_print(
-                f"[epoch {epoch}] " + " ".join(f"{k}={v:.4f}" for k, v in last.items())
-            )
-            if self.records is not None:
-                self.records.log_epoch(epoch, **{k: v for k, v in last.items()})
-            if self.tb is not None:
-                for k, v in last.items():
-                    group = "val" if k.startswith("val_") else "train"
-                    self.tb.add_scalar(f"{group}/{k}", v, epoch)
-                self.tb.flush()
-            metric = val_m.get("val_top1")
-            self.ckpt.save(self.state, epoch, metric=metric,
-                           **({"best_k": val_m["best_k"]} if "best_k" in val_m else {}))
-            if val_m:
-                self._compile_sentinel_ready = True  # arm at next epoch top
-        # the drain below can block on device_gets for an in-flight async
-        # save — that is backend work, so it stays under the heartbeat
-        # (writes are atomic, so a fire mid-drain cannot truncate; the
-        # supervisor's restart then auto-resumes into an already-complete
-        # run and exits cleanly)
-        self._heartbeat.touch()
-        if self.compile_sentinel.armed:
-            # surface the last epoch's recompiles, then release the logger
-            self.compile_sentinel.check(strict=cfg.run.strict_compile)
+        finally:
+            # every exit path — completion, strict-compile raise, PodAbort,
+            # sentinel divergence, SIGTERM — must release the pxla DEBUG
+            # logger; disarm is idempotent (refcounted module handler)
             self.compile_sentinel.disarm()
         self.ckpt.wait()  # land any in-flight async checkpoint before returning
         self._heartbeat.stop()

@@ -1,14 +1,22 @@
 #!/usr/bin/env bash
 # Program-invariant analyzer over the repo itself — the CI gate.
 #
-# Runs every pass of cli.analyze (jaxpr/HLO donation audit + host-sync and
-# rc-catalogue lint) on CPU and exits with its code: 0 clean, 1 findings
+# Runs every pass of cli.analyze (jaxpr/HLO donation audit, host-sync and
+# rc-catalogue lint, sharding/comms audit of the program × composed-mesh
+# matrix) on CPU and diffs the sharded records against the committed
+# analysis/baselines.json, exiting with its code: 0 clean, 1 findings
 # (each printed as `[check] where: message`; runbook docs/analysis.md),
-# 2 usage error. Extra flags pass through, e.g.:
+# 2 usage error. The analyzer self-forces a multi-device CPU topology, so
+# this runs identically on any host. Extra flags pass through, e.g.:
 #
-#   bash scripts/lint.sh                      # all passes
+#   bash scripts/lint.sh                      # all passes + baseline diff
 #   bash scripts/lint.sh --passes lint        # AST passes only (fast)
 #   bash scripts/lint.sh --json /tmp/a.json   # machine copy of findings
+#
+# After an INTENTIONAL program change (new sharding rule, optimizer, step
+# structure), regenerate the fence and commit the diff:
+#
+#   python -m ddp_classification_pytorch_tpu.cli.analyze --update-baseline
 #
 # Flags used here are locked against the cli.analyze parser by
 # tests/test_scripts_meta.py.
@@ -16,4 +24,4 @@ set -euo pipefail
 cd "$(dirname "$0")/.."
 
 JAX_PLATFORMS=cpu exec python -m ddp_classification_pytorch_tpu.cli.analyze \
-    --passes jaxpr,lint "$@"
+    --passes jaxpr,lint,sharding --diff-baseline "$@"

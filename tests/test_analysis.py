@@ -34,11 +34,25 @@ from ddp_classification_pytorch_tpu.analysis.jaxpr_audit import (
     build_registry,
     donation_evidence,
 )
+from ddp_classification_pytorch_tpu.analysis import baseline as baselib
 from ddp_classification_pytorch_tpu.analysis.lint import (
     lint_factory_source,
     lint_rc_sites,
     lint_rc_source,
     lint_step_factories,
+)
+from ddp_classification_pytorch_tpu.analysis.sharding_audit import (
+    EVAL_COMMS,
+    TRAIN_COMMS,
+    _param_bytes,
+    _spans_data,
+    audit_collectives,
+    audit_sharded_case,
+    audit_sharding_table,
+    collective_inventory,
+    parse_replica_groups,
+    sharded_registry,
+    step_comms_evidence,
 )
 
 # --------------------------------------------------------------- fixtures --
@@ -46,15 +60,36 @@ from ddp_classification_pytorch_tpu.analysis.lint import (
 
 @pytest.fixture(scope="module")
 def audit():
-    """The one expensive piece: the full registry audit (state inits, six
-    jaxpr traces, two donated-step compiles) — shared by every
-    real-repo assertion below."""
+    """The one expensive piece: the full registry audit (state inits, the
+    jaxpr traces incl. the dp×tp entries, two donated-step compiles) —
+    shared by every real-repo assertion below."""
     from types import SimpleNamespace
 
     ctx = AuditContext()
     findings, specs = audit_registry(ctx)
     return SimpleNamespace(ctx=ctx, findings=findings,
                            specs={s.name: s for s in specs})
+
+
+@pytest.fixture(scope="module")
+def sharded(audit):
+    """Tier-1-lean sharded matrix subset: ONE lower+compile per composed
+    mesh — the dp2 train cell (the acceptance cell: gradient all-reduce
+    set + donation coverage under a ≥2-device mesh) and the dp2tp2 eval
+    cell (the model-axis layout). The full 8-cell matrix runs in the
+    slow-marked CLI test and in scripts/lint.sh."""
+    from types import SimpleNamespace
+
+    want = {"train_step@dp2", "eval_step@dp2tp2"}
+    findings, records = [], {}
+    for case in sharded_registry():
+        if case.key not in want:
+            continue
+        f, rec = audit_sharded_case(case, audit.ctx)
+        findings += f
+        records[case.key] = rec
+    assert set(records) == want  # the registry must keep both cells
+    return SimpleNamespace(findings=findings, records=records)
 
 
 def _fixture_spec(fn, args, **kw):
@@ -201,7 +236,11 @@ def test_registry_names_every_step_program():
     names = {s.name for s in build_registry()}
     assert names == {"train_step", "eval_step", "nested_eval_step",
                      "plc_predict", "topk_predict", "shard_map_train_step",
-                     "train_step_survivor"}
+                     "train_step_survivor",
+                     # the same eval-family programs traced under the
+                     # composed dp×tp mesh (sharded audit satellites)
+                     "eval_step_dp_tp", "nested_eval_step_dp_tp",
+                     "plc_predict_dp_tp", "topk_predict_dp_tp"}
     for spec in build_registry():
         # every entry either donates or documents why it must not
         assert spec.donate or spec.no_donate_reason, spec.name
@@ -374,3 +413,258 @@ def test_donation_evidence_fields():
 def test_finding_renders_as_one_line():
     f = Finding("donation", "train_step", "gap", {"bytes": 4})
     assert str(f) == "[donation] train_step: gap"
+
+
+# -------------------------------------------- sharding & comms audit --
+
+
+def test_sharded_cells_audit_clean(sharded):
+    assert sharded.findings == [], [str(f) for f in sharded.findings]
+
+
+def test_dp_train_step_carries_gradient_allreduce_set(sharded, audit):
+    """The acceptance invariant: under a ≥2-device data mesh the train
+    step's ONLY collective kind is all-reduce, the data-spanning payload
+    covers every parameter byte (the gradient set is present, not
+    truncated), and donation coverage stays exactly 1.0."""
+    rec = sharded.records["train_step@dp2"]
+    assert set(rec["collectives"]) == {"all-reduce"}
+    ar = rec["collectives"]["all-reduce"]
+    got = sum(b for label, b in ar["axes"].items() if _spans_data(label))
+    assert got >= _param_bytes(audit.ctx) > 10_000_000
+    assert rec["donation_coverage"] == 1.0
+
+
+def test_eval_dp_tp_cell_is_collective_lean_and_model_sharded(sharded):
+    """Under the composed dp×tp mesh eval stays control-sized on the wire
+    (scalar metric reductions only) and GSPMD actually split the fc kernel
+    over the model axis while the batch rode the data axis."""
+    rec = sharded.records["eval_step@dp2tp2"]
+    assert rec["collective_bytes_per_step"] < 16 * 1024
+    specs = " | ".join(rec["sharded_leaves"].values())
+    assert "'model'" in specs and "'data'" in specs
+
+
+def test_sharded_records_match_committed_baseline(sharded):
+    """The tier-1 fence: the lean cells, recompiled here, must sit within
+    the committed baseline's tolerances (subset mode: the full matrix is
+    lint.sh's job)."""
+    base = baselib.load_baseline()
+    diff = baselib.diff_baseline(sharded.records, base, subset=True)
+    assert diff == [], [str(f) for f in diff]
+
+
+def test_zero_detector_fires_on_replicated_buffer(audit):
+    """A weight-sized buffer replicated across a >1 data axis must flag —
+    and the same buffer sharded over data (or a 1-wide data mesh) must
+    not."""
+    from jax.sharding import NamedSharding
+    from jax.sharding import PartitionSpec as P
+
+    mesh = audit.ctx.composed_mesh("dp2")
+    rows = [{"path": ".params.big", "shape": (2048, 2048),
+             "dtype": "float32", "bytes": 2048 * 2048 * 4,
+             "spec": str(P()), "_sharding": NamedSharding(mesh, P())}]
+    findings = audit_sharding_table(rows, mesh, "fixture")
+    assert findings and findings[0].check == "sharding"
+    assert "replicated" in findings[0].message
+    rows[0]["_sharding"] = NamedSharding(mesh, P("data"))
+    assert audit_sharding_table(rows, mesh, "fixture") == []
+    assert audit_sharding_table(rows, audit.ctx.mesh, "fixture") == []
+
+
+def test_resharding_detector_fires_on_forced_gather(audit):
+    """A data-sharded weight-sized array forced replicated mid-program
+    compiles to a big all-gather: the implicit-resharding detector and the
+    per-op payload cap must both trip."""
+    from jax.sharding import NamedSharding
+    from jax.sharding import PartitionSpec as P
+
+    mesh = audit.ctx.composed_mesh("dp2")
+    x = jax.ShapeDtypeStruct(
+        (1024, 256), jnp.float32,
+        sharding=NamedSharding(mesh, P("data")))
+
+    @jax.jit
+    def gathered(x):
+        return jax.lax.with_sharding_constraint(
+            x, NamedSharding(mesh, P())) * 2.0
+
+    ev = step_comms_evidence(gathered, (x,), donated_argnums=(), mesh=mesh)
+    findings = audit_collectives(ev["collectives"], EVAL_COMMS, "fixture")
+    assert any(f.check == "resharding" for f in findings), \
+        [str(f) for f in findings]
+    assert any(f.check == "comms" for f in findings)
+
+
+def test_comms_detector_fires_on_policy_violations():
+    """Disallowed kind + oversized allowed kind, on a fabricated inventory
+    (detector logic is pure — no compile needed)."""
+    inv = {"kinds": {"all-reduce": {"count": 1, "bytes": 262144,
+                                    "max_op_bytes": 262144,
+                                    "axes": {"data": 262144}},
+                     "all-to-all": {"count": 1, "bytes": 64,
+                                    "max_op_bytes": 64,
+                                    "axes": {"data": 64}}},
+           "total_bytes": 262208}
+    findings = audit_collectives(inv, EVAL_COMMS, "fixture")
+    msgs = " | ".join(f.message for f in findings)
+    assert "all-to-all" in msgs  # kind outside the policy
+    assert "262,144" in msgs     # allowed kind over the per-op cap
+    assert all(f.check == "comms" for f in findings)
+
+
+def test_grad_allreduce_floor_detector():
+    """The missing-gradient-set detector: no all-reduce at all fires;
+    model-axis-only reduces do NOT satisfy the data-spanning floor;
+    full-mesh ('all', XLA's replica_groups={} form) reduces do."""
+    empty = {"kinds": {}, "total_bytes": 0}
+    findings = audit_collectives(empty, TRAIN_COMMS, "fixture",
+                                 min_grad_bytes=1000)
+    assert findings and "gradient all-reduce set" in findings[0].message
+    inv = {"kinds": {"all-reduce": {"count": 1, "bytes": 2000,
+                                    "max_op_bytes": 2000,
+                                    "axes": {"model": 2000}}},
+           "total_bytes": 2000}
+    assert audit_collectives(inv, TRAIN_COMMS, "fixture",
+                             min_grad_bytes=1000)
+    inv["kinds"]["all-reduce"]["axes"] = {"all": 2000}
+    assert audit_collectives(inv, TRAIN_COMMS, "fixture",
+                             min_grad_bytes=1000) == []
+
+
+def test_parse_replica_groups_forms():
+    assert parse_replica_groups("replica_groups={{0,2},{1,3}}") == frozenset(
+        {frozenset({0, 2}), frozenset({1, 3})})
+    assert parse_replica_groups("replica_groups=[2,2]<=[4]") == frozenset(
+        {frozenset({0, 1}), frozenset({2, 3})})
+    assert parse_replica_groups(
+        "replica_groups=[2,2]<=[2,2]T(1,0)") == frozenset(
+        {frozenset({0, 2}), frozenset({1, 3})})
+    assert parse_replica_groups("replica_groups={}") == frozenset()
+    assert parse_replica_groups("no groups here") is None
+
+
+def test_empty_replica_groups_attributes_to_full_mesh(audit):
+    """HLO `replica_groups={}` = every device, one group — the form XLA
+    emits for the dp×tp full-mesh gradient reduces. It must land on 'all'
+    (which spans the data axis), never on degenerate 'none' — the exact
+    misattribution that would false-fire the gradient floor."""
+    mesh = audit.ctx.composed_mesh("dp2tp2")
+    hlo = ("  %r = f32[100]{0} all-reduce(f32[100]{0} %x), "
+           "replica_groups={}, to_apply=%sum\n")
+    inv = collective_inventory(hlo, mesh)
+    assert inv["kinds"]["all-reduce"]["axes"] == {"all": 400}
+    assert _spans_data("all") and _spans_data("data+model")
+    assert not _spans_data("model")
+
+
+def test_step_comms_evidence_fields(audit):
+    """bench.py's e2e evidence rides this helper: donation fields plus the
+    comms/memory fields, all from ONE compile."""
+    from jax.sharding import NamedSharding
+    from jax.sharding import PartitionSpec as P
+
+    mesh = audit.ctx.composed_mesh("dp2")
+    s = jnp.zeros((64, 64), jnp.float32)
+    x = jax.ShapeDtypeStruct((8, 4), jnp.float32,
+                             sharding=NamedSharding(mesh, P("data")))
+    fn = jax.jit(lambda s, x: (s + x.sum(), x * 2), donate_argnums=0)
+    ev = step_comms_evidence(fn, (s, x), mesh=mesh)
+    assert ev["donated_bytes"] == 64 * 64 * 4
+    assert ev["donation_coverage"] == 1.0
+    assert ev["collective_bytes_per_step"] > 0  # the sharded partial sum
+    assert ev["peak_hbm_bytes"] > 0
+    assert ev["memory"]["peak_hbm_bytes"] == ev["peak_hbm_bytes"]
+
+
+# ------------------------------------------------------ program baselines --
+
+
+def _baseline_rec(**over):
+    rec = {"collectives": {"all-reduce": {"count": 2, "bytes": 1000,
+                                          "max_op_bytes": 800,
+                                          "axes": {"data": 1000}}},
+           "collective_bytes_per_step": 1000,
+           "peak_hbm_bytes": 10_000,
+           "sharded_leaves": {
+               ".params.fc.kernel": "PartitionSpec(None, 'model')"},
+           "donation_coverage": 1.0}
+    rec.update(over)
+    return rec
+
+
+def test_baseline_diff_flags_each_drift_class():
+    base = {"tolerances": dict(baselib.DEFAULT_TOLERANCES),
+            "programs": {"p@dp2": _baseline_rec()}}
+    # within tolerance (and shrinkage) is NOT drift
+    ok = {"p@dp2": _baseline_rec(collective_bytes_per_step=1050,
+                                 peak_hbm_bytes=9_000)}
+    assert baselib.diff_baseline(ok, base) == []
+    drifted = {"p@dp2": _baseline_rec(
+        collectives={"all-reduce": {"count": 2, "bytes": 1000,
+                                    "max_op_bytes": 800,
+                                    "axes": {"data": 1000}},
+                     "all-gather": {"count": 1, "bytes": 200,
+                                    "max_op_bytes": 200,
+                                    "axes": {"model": 200}}},
+        collective_bytes_per_step=1200,             # +20% payload
+        peak_hbm_bytes=12_000,                      # +20% peak
+        sharded_leaves={},                          # fc now replicated
+        donation_coverage=0.9)}                     # regression
+    findings = baselib.diff_baseline(drifted, base)
+    joined = " | ".join(f.message for f in findings)
+    assert "new collective kind" in joined
+    assert "payload grew" in joined
+    assert "peak HBM grew" in joined
+    assert "downgrade" in joined
+    assert "coverage regressed" in joined
+    assert len(findings) == 5
+    assert all(f.check == "baseline" for f in findings)
+
+
+def test_baseline_diff_flags_missing_and_new_programs():
+    base = {"programs": {"gone@dp2": _baseline_rec()}}
+    findings = baselib.diff_baseline({"new@dp2": _baseline_rec()}, base)
+    joined = " | ".join(f.message for f in findings)
+    assert "not in the committed baseline" in joined
+    assert "missing from the fresh audit" in joined
+    # subset mode (the tier-1 lean cells): absent programs don't flag,
+    # an unknown new one still does
+    sub = baselib.diff_baseline({"new@dp2": _baseline_rec()}, base,
+                                subset=True)
+    assert len(sub) == 1 and "not in the committed baseline" in sub[0].message
+
+
+def test_baseline_roundtrip_and_provenance(tmp_path):
+    path = str(tmp_path / "b.json")
+    records = {"p@dp2": _baseline_rec()}
+    baselib.write_baseline(records, path, context={"arch": "resnet18"})
+    base = baselib.load_baseline(path)
+    assert base["programs"] == records
+    assert base["_provenance"]["config"]["arch"] == "resnet18"
+    assert base["tolerances"] == baselib.DEFAULT_TOLERANCES
+    assert baselib.diff_baseline(records, base) == []
+    with pytest.raises(FileNotFoundError, match="--update-baseline"):
+        baselib.load_baseline(str(tmp_path / "absent.json"))
+
+
+def test_analyze_parser_accepts_baseline_flags():
+    from ddp_classification_pytorch_tpu.cli.analyze import build_parser
+
+    ns = build_parser().parse_args(["--diff-baseline"])
+    assert ns.diff_baseline and not ns.update_baseline
+    ns = build_parser().parse_args(["--diff_baseline",
+                                    "--baseline", "x.json"])
+    assert ns.diff_baseline and ns.baseline == "x.json"
+    assert build_parser().parse_args(["--update-baseline"]).update_baseline
+
+
+@pytest.mark.slow
+def test_analyze_cli_diff_baseline_clean(capsys):
+    """The acceptance run: the FULL sharded matrix recompiled and diffed
+    against the committed baseline exits 0 on a clean tree."""
+    from ddp_classification_pytorch_tpu.cli.analyze import main
+
+    main(["--passes", "sharding", "--diff-baseline"])
+    assert "clean" in capsys.readouterr().out

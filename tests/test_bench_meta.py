@@ -151,6 +151,12 @@ def test_bench_e2e_row_smoke_cpu():
     assert row["aliased_bytes"] == row["donated_bytes"]
     assert row["donation_coverage"] == 1.0
     assert row["temp_bytes"] > 0
+    # comms/memory evidence from the SAME compile window
+    # (analysis/sharding_audit.step_comms_evidence): a dp-sharded train
+    # step carries the gradient all-reduce payload, and the executable's
+    # peak HBM exceeds the donated state it updates in place
+    assert row["collective_bytes_per_step"] > 0
+    assert row["peak_hbm_bytes"] > row["donated_bytes"]
 
 
 def test_bench_e2e_row_float32_wire_bytes():

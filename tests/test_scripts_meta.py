@@ -125,12 +125,16 @@ def test_lint_script_flags_match_analyze_cli():
         known.update(action.option_strings)
     body = _script_body("lint.sh")
     assert "ddp_classification_pytorch_tpu.cli.analyze" in body
-    passed = set(re.findall(r"(?<![\w-])--[a-z_]+", body))
+    # hyphen-aware: `--diff-baseline` must match whole, not truncate to
+    # `--diff` (which the parser would reject)
+    passed = set(re.findall(r"(?<![\w-])--[a-z_]+(?:-[a-z_]+)*", body))
     assert passed, "lint.sh passes no flags — gate gutted?"
     unknown = sorted(passed - known)
     assert not unknown, f"lint.sh passes flags cli.analyze rejects: {unknown}"
-    # the gate must run BOTH pass families, on CPU
-    assert "jaxpr" in body and "lint" in body
+    # the gate must run ALL pass families, on CPU, and diff the committed
+    # program baseline (the sharding/comms regression fence)
+    assert "jaxpr" in body and "lint" in body and "sharding" in body
+    assert "--diff-baseline" in body
     assert "JAX_PLATFORMS=cpu" in body
 
 
