@@ -1,0 +1,110 @@
+"""`scenario` entrypoint — the supervised train→serve chaos drill
+(scenario/; runbook: docs/operations.md "Scenario drill").
+
+    python -m ddp_classification_pytorch_tpu.cli.scenario \
+        --scenario_spec scenario.json --out runs/scenario
+
+Launches an elastic trainer pod publishing checkpoints into a shared run
+dir while serve replicas sustain offered load, drives the chaos timeline
+from the spec, then replays the recorded `events.jsonl` through the S1–S4
+invariant checkers. `--check_only` skips the run and re-checks an existing
+events file (post-mortem of a red run, and how the synthetic-timeline tests
+prove each checker fires).
+
+rc discipline (registered in analysis/lint.py's 0–11 catalogue):
+
+- **0** — run converged AND every invariant held;
+- **1** — an invariant was violated, or a supervised process failed
+  (trainer rc != 0 through its restart budget, replica drain broke,
+  analyzer gate red);
+- **2** — malformed `--scenario_spec` (deterministic; never retried).
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+from typing import Optional, Sequence
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="ddp_classification_pytorch_tpu.cli.scenario",
+        description="supervised train→serve chaos scenario with "
+                    "machine-checked safety/availability invariants",
+    )
+    p.add_argument("--scenario_spec", required=True,
+                   help="path to a scenario JSON file, or an inline JSON "
+                        "object (docs/operations.md has the grammar); "
+                        "malformed specs exit rc 2")
+    p.add_argument("--out", default="runs/scenario",
+                   help="run dir shared by the trainer pod and the serve "
+                        "replicas (checkpoints, logs, events.jsonl)")
+    p.add_argument("--events", default="",
+                   help="events.jsonl path (default <out>/events.jsonl); "
+                        "with --check_only, the timeline to re-check")
+    p.add_argument("--check_only", action="store_true",
+                   help="skip the run: replay an existing events file "
+                        "through the S1–S4 checkers only")
+    p.add_argument("--skip_lint", action="store_true",
+                   help="skip the end-of-run analyzer gate (lint.sh) and "
+                        "the S4 check — for quick iteration, not CI")
+    return p
+
+
+def main(argv: Optional[Sequence[str]] = None) -> None:
+    args = build_parser().parse_args(argv)
+    from ..scenario.spec import SpecError, load_spec
+
+    try:
+        spec = load_spec(args.scenario_spec)
+    except SpecError as e:
+        print(f"[scenario] spec error: {e}", file=sys.stderr)
+        raise SystemExit(2) from None
+
+    events_path = args.events or os.path.join(args.out, "events.jsonl")
+    run_rc = 0
+    if not args.check_only:
+        from ..scenario.supervisor import ScenarioSupervisor
+
+        sup = ScenarioSupervisor(spec, args.out, events_path,
+                                 skip_lint=args.skip_lint)
+        print(f"[scenario] drill: {spec.trainer.hosts} trainer host(s), "
+              f"{spec.serve.replicas} serve replica(s), "
+              f"{spec.load.rps} rps offered → {args.out}")
+        run_rc = sup.run()
+        for f in sup.failures:
+            print(f"[scenario] FAIL: {f}", file=sys.stderr)
+
+    from ..scenario.events import read_events
+    from ..scenario.invariants import check_invariants
+
+    events = read_events(events_path)
+    if not events:
+        print(f"[scenario] no events at {events_path} — nothing to check",
+              file=sys.stderr)
+        raise SystemExit(1)
+    restarts = os.path.join(args.out, "restarts.log")
+    violations = check_invariants(
+        events, spec,
+        restarts_logs=[restarts] if os.path.exists(restarts) else None,
+        require_lint=not args.skip_lint)
+    by_kind: dict = {}
+    for e in events:
+        by_kind[e["kind"]] = by_kind.get(e["kind"], 0) + 1
+    print(f"[scenario] {len(events)} events: "
+          + ", ".join(f"{k}={v}" for k, v in sorted(by_kind.items())))
+    for v in violations:
+        print(f"[scenario] VIOLATION {v}", file=sys.stderr)
+    if violations or run_rc != 0:
+        print(f"[scenario] RED: {len(violations)} violation(s), "
+              f"run rc={run_rc}", file=sys.stderr)
+        raise SystemExit(1)
+    print("[scenario] GREEN: S1 verified-serve, S2 availability floor, "
+          "S3 bounded adoption"
+          + ("" if args.skip_lint else ", S4 analyzer gate") + " all held")
+
+
+if __name__ == "__main__":
+    main()

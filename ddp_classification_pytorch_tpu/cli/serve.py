@@ -258,9 +258,16 @@ def main(argv: Optional[Sequence[str]] = None) -> None:
 
     watcher = None
     if cfg.serve.watch_dir:
+        from ..utils import chaos as chaoslib
+
+        # watcher_io drills aim CHAOS_FAULT_SPEC at a replica; one-shot
+        # markers live under this replica's own out dir, not the shared
+        # watch dir (each replica owns its poll counter)
+        plan = chaoslib.plan_for_run("", cfg.run.out_dir or ".", 0)
         watcher = CheckpointWatcher(cfg.serve.watch_dir, engine, state,
                                     poll_s=cfg.serve.reload_poll_s,
-                                    metrics=metrics)
+                                    metrics=metrics,
+                                    chaos=plan if plan else None)
         loaded = watcher.restore_initial()
         host0_print(f"[serve] watching {cfg.serve.watch_dir} "
                     + (f"(serving epoch {loaded})" if loaded >= 0 else
@@ -319,9 +326,13 @@ def main(argv: Optional[Sequence[str]] = None) -> None:
     if cfg.serve.port:
         from ..serve.http import start_server
 
-        server = start_server(engine, cfg.serve.port)
+        server = start_server(engine, cfg.serve.port, watcher=watcher)
         host0_print(f"[serve] http on :{cfg.serve.port} "
                     "(POST /predict, GET /healthz, GET /metrics)")
+    from ..scenario.events import emit
+
+    emit("serve_ready", port=cfg.serve.port,
+         epoch=(watcher.loaded_epoch if watcher is not None else -1))
 
     step = 0
     while not stop.wait(cfg.serve.log_every_s):
@@ -337,11 +348,13 @@ def main(argv: Optional[Sequence[str]] = None) -> None:
     # already-accepted request is served, then exit 0
     host0_print("[serve] SIGTERM/SIGINT: draining — intake stopped, "
                 f"{engine.queue_depth} request(s) queued")
+    emit("drain_begin", queued=engine.queue_depth)
     if server is not None:
         server.shutdown()
     if watcher is not None:
         watcher.stop()
     engine.drain()
+    emit("drain_end")
     host0_print(metrics.log_line(engine.queue_depth))
     if tb is not None:
         metrics.to_tensorboard(tb, step)

@@ -718,6 +718,11 @@ def write_membership(out_dir: str, generation: int, world) -> None:
     with open(tmp, "w") as f:
         f.write(membership_line(generation, world) + "\n")
     os.replace(tmp, path)
+    # scenario evidence (env-gated no-op outside a drill): the membership
+    # generation bump IS the re-formation event S3 tracks across rc 11
+    from ..scenario.events import emit
+
+    emit("reform", gen=int(generation), world=[int(h) for h in world])
 
 
 def read_membership(out_dir: str) -> Tuple[int, list]:

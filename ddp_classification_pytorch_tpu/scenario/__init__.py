@@ -1,0 +1,21 @@
+"""Continuous train→serve chaos scenario (docs/operations.md runbook).
+
+The first code path that composes every robustness layer the repo has:
+an elastic trainer pod (supervise.sh + FLEET_ELASTIC) publishes verified
+checkpoints into a shared run dir while serve replicas (ServingEngine +
+CheckpointWatcher) sustain offered HTTP load, a declarative chaos timeline
+injects train- AND serve-side faults, and every observable transition —
+publish, verify, quarantine, swap, 503, re-form generation bump — lands in
+one machine-readable `events.jsonl`. The invariant checker replays that
+timeline and asserts the four production contracts (S1 verified-serve,
+S2 availability floor, S3 bounded adoption, S4 analyzer still green).
+
+Submodules (all stdlib-only — the supervisor shells out to the real
+trainer/server processes instead of importing their jax stacks):
+
+- `events`     — append-only JSONL event log + the env-gated `emit()`
+                 hook the serve/train/fleet code calls;
+- `spec`       — the `--scenario_spec` JSON grammar + validation (rc 2);
+- `invariants` — S1–S4 checkers over a parsed event timeline;
+- `supervisor` — the process orchestrator behind `cli.scenario`.
+"""

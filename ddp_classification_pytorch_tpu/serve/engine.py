@@ -60,11 +60,15 @@ class EngineClosed(RuntimeError):
 
 @dataclass
 class Prediction:
-    """Per-request result: top-k class indices + softmax scores."""
+    """Per-request result: top-k class indices + softmax scores, plus the
+    provenance of the params that answered (which checkpoint digest and
+    generation the batch ran under — the S1 verified-serve evidence)."""
 
     indices: np.ndarray  # (k,) int32
     scores: np.ndarray   # (k,) float32
     latency_ms: float    # submit → result, end to end
+    digest: str = "fresh"  # sha256 of the adopted checkpoint; "fresh" = init
+    generation: int = -1   # adopted checkpoint epoch; -1 = never reloaded
 
 
 @dataclass
@@ -117,7 +121,11 @@ class ServingEngine:
         self.metrics = metrics
         self._q: "queue.Queue[_Request]" = queue.Queue(maxsize=int(queue_depth))
         self._swap_lock = threading.Lock()
-        self._pending_state: Optional[Any] = None
+        self._pending_state: Optional[Tuple[Any, str, int]] = None
+        # provenance of the params currently answering: "fresh" until the
+        # first verified checkpoint is adopted (swap_state with a digest)
+        self._digest = "fresh"
+        self._generation = -1
         self._closed = False
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
@@ -195,11 +203,27 @@ class ServingEngine:
         return self.submit(arr)
 
     # ---------------------------------------------------------- hot reload --
-    def swap_state(self, new_state: Any) -> None:
+    def swap_state(self, new_state: Any, digest: str = "",
+                   generation: int = -1) -> None:
         """Publish new params; adopted atomically at the next batch boundary
-        (serve/reload.py calls this from the watcher thread)."""
+        (serve/reload.py calls this from the watcher thread). `digest` and
+        `generation` name the verified checkpoint the params came from, so
+        every Prediction (and /healthz) can attest which weights answered."""
         with self._swap_lock:
-            self._pending_state = new_state
+            self._pending_state = (new_state, digest or "fresh",
+                                   int(generation))
+
+    @property
+    def params_digest(self) -> str:
+        """sha256 of the checkpoint currently answering ("fresh" = init
+        params, nothing adopted yet)."""
+        with self._swap_lock:
+            return self._digest
+
+    @property
+    def params_generation(self) -> int:
+        with self._swap_lock:
+            return self._generation
 
     # ------------------------------------------------------------- serving --
     def _bucket_for(self, n: int) -> int:
@@ -230,8 +254,12 @@ class ServingEngine:
     def _run_batch(self, reqs) -> None:
         with self._swap_lock:
             if self._pending_state is not None:
-                self._state = self._pending_state
+                self._state, self._digest, self._generation = \
+                    self._pending_state
                 self._pending_state = None
+            # capture under the lock: the whole batch is answered by ONE
+            # params version even if a swap lands mid-flight
+            digest, generation = self._digest, self._generation
         n = len(reqs)
         bucket = self._bucket_for(n)
         h = self.image_size
@@ -256,7 +284,9 @@ class ServingEngine:
         for i, r in enumerate(reqs):  # pad rows [n:] are discarded here
             lat_ms = (now - r.t_submit) * 1e3
             lats.append(lat_ms)
-            r.future.set_result(Prediction(indices[i], scores[i], lat_ms))
+            r.future.set_result(Prediction(indices[i], scores[i], lat_ms,
+                                           digest=digest,
+                                           generation=generation))
         self.metrics.record_batch(bucket, n, lats)
         self._check_compile_sentinel()
 

@@ -5,11 +5,20 @@ module only maps HTTP onto it with `http.server` from the standard library —
 no web framework, matching the repo's zero-new-deps rule:
 
     POST /predict   body = an image file (anything PIL opens: JPEG/PNG)
-                    → 200 {"topk": [[class, score], ...], "latency_ms": N}
-                    → 503 when the queue is full (backpressure) or draining
+                    → 200 {"topk": [[class, score], ...], "latency_ms": N,
+                           "digest": <params sha256>, "generation": N}
+                    → 503 {"state": "busy"} + Retry-After: 1 (queue full —
+                      backpressure, retry soon) or {"state": "draining"} +
+                      Retry-After: 5 (replica going away — pick another)
                     → 400 on undecodable bodies
-    GET  /healthz   → 200 {"ok": true, ...metrics snapshot}
+    GET  /healthz   → 200 {"ok": ..., "digest": ..., "generation": ...,
+                           "watcher_alive": ..., ...metrics snapshot}
     GET  /metrics   → 200 metrics snapshot JSON
+
+A load balancer (or the scenario supervisor) reads /healthz to tell
+degraded from dead: `ok` false means draining, `watcher_alive` false means
+hot-reload stopped (stale-params risk even though requests still answer),
+and digest/generation attest exactly which verified checkpoint is serving.
 
 `ThreadingHTTPServer` gives one handler thread per connection; every handler
 just blocks on its request future, so concurrency is bounded by the engine's
@@ -22,7 +31,7 @@ import io
 import json
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
-from typing import Any
+from typing import Any, Optional
 
 from .engine import EngineClosed, QueueFull
 
@@ -30,13 +39,17 @@ from .engine import EngineClosed, QueueFull
 class ServeHandler(BaseHTTPRequestHandler):
     # set by make_server on the handler class
     engine: Any = None
+    watcher: Any = None  # CheckpointWatcher when serving with --watch
     request_timeout_s: float = 30.0
 
-    def _json(self, code: int, payload: dict) -> None:
+    def _json(self, code: int, payload: dict,
+              headers: Optional[dict] = None) -> None:
         body = json.dumps(payload).encode()
         self.send_response(code)
         self.send_header("Content-Type", "application/json")
         self.send_header("Content-Length", str(len(body)))
+        for k, v in (headers or {}).items():
+            self.send_header(k, v)
         self.end_headers()
         self.wfile.write(body)
 
@@ -44,7 +57,16 @@ class ServeHandler(BaseHTTPRequestHandler):
         if self.path in ("/healthz", "/metrics"):
             snap = self.engine.metrics.snapshot(self.engine.queue_depth)
             if self.path == "/healthz":
-                snap = {"ok": not self.engine.closed, **snap}
+                snap = {
+                    "ok": not self.engine.closed,
+                    "digest": self.engine.params_digest,
+                    "generation": self.engine.params_generation,
+                    # None = no watcher configured (--ckpt pins the params);
+                    # False = the reload thread died — stale-params risk
+                    "watcher_alive": (self.watcher.alive
+                                      if self.watcher is not None else None),
+                    **snap,
+                }
             self._json(200, snap)
             return
         self._json(404, {"error": f"unknown path {self.path!r}"})
@@ -66,8 +88,17 @@ class ServeHandler(BaseHTTPRequestHandler):
         try:
             future = self.engine.submit_image(img)
             pred = future.result(timeout=self.request_timeout_s)
-        except (QueueFull, EngineClosed) as e:
-            self._json(503, {"error": str(e)})
+        except QueueFull as e:
+            # backpressure: the queue will turn over within a batch or two —
+            # retry against the SAME replica shortly
+            self._json(503, {"error": str(e), "state": "busy"},
+                       headers={"Retry-After": "1"})
+            return
+        except EngineClosed as e:
+            # draining: this replica is going away — clients should go to
+            # another replica; Retry-After covers a typical relaunch
+            self._json(503, {"error": str(e), "state": "draining"},
+                       headers={"Retry-After": "5"})
             return
         except Exception as e:
             self._json(500, {"error": f"{type(e).__name__}: {e}"})
@@ -76,24 +107,28 @@ class ServeHandler(BaseHTTPRequestHandler):
             "topk": [[int(c), float(s)]
                      for c, s in zip(pred.indices, pred.scores)],
             "latency_ms": round(pred.latency_ms, 3),
+            "digest": pred.digest,
+            "generation": pred.generation,
         })
 
     def log_message(self, fmt, *args):  # route through one logger, not stderr spam
         pass
 
 
-def make_server(engine: Any, port: int,
-                request_timeout_s: float = 30.0) -> ThreadingHTTPServer:
+def make_server(engine: Any, port: int, request_timeout_s: float = 30.0,
+                watcher: Any = None) -> ThreadingHTTPServer:
     """Bind a ThreadingHTTPServer over `engine` (not yet serving)."""
     handler = type("BoundServeHandler", (ServeHandler,), {
-        "engine": engine, "request_timeout_s": request_timeout_s})
+        "engine": engine, "watcher": watcher,
+        "request_timeout_s": request_timeout_s})
     return ThreadingHTTPServer(("0.0.0.0", port), handler)
 
 
-def start_server(engine: Any, port: int) -> ThreadingHTTPServer:
+def start_server(engine: Any, port: int,
+                 watcher: Any = None) -> ThreadingHTTPServer:
     """Serve on a daemon thread; caller owns shutdown (`server.shutdown()`
     before `engine.drain()` so no handler blocks on a draining engine)."""
-    server = make_server(engine, port)
+    server = make_server(engine, port, watcher=watcher)
     threading.Thread(target=server.serve_forever, daemon=True,
                      name="serve-http").start()
     return server

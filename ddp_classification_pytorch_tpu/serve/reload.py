@@ -13,10 +13,22 @@ at the engine:
   next-newest candidate — exactly the --auto_resume semantics of PR 2;
 - the swap is `ServingEngine.swap_state()`: the batcher adopts the new
   params at a batch boundary, so no micro-batch ever mixes two checkpoints.
+  The swap carries the verified sha256 + epoch so every answer (and
+  /healthz) attests which weights served it.
 
 A failed reload is therefore invisible to clients: the engine keeps serving
 the previous verified params, and the only trace is the quarantined file
 plus a `reloads_rejected` tick in the metrics.
+
+The poll itself is hardened against the shared filesystem it watches: a
+file vanishing between scan and hash, an ENOENT/EIO mid-poll, a run dir
+briefly unmounted — any OSError (or other surprise) is logged, counted,
+and answered with a bounded exponential backoff (poll_s · 2^errors, capped
+at `max_backoff_s`), after which the SAME thread re-arms and polls again.
+The watcher never dies quietly: `alive` is surfaced in /healthz, and the
+error/backoff transitions land in the scenario event log. A dead watcher
+would mean a replica serving stale params forever with no signal — the
+failure mode this module refuses to have.
 """
 
 from __future__ import annotations
@@ -24,6 +36,7 @@ from __future__ import annotations
 import threading
 from typing import Any, Optional
 
+from ..scenario.events import emit
 from ..train.checkpoint import CheckpointManager
 from ..utils.logging import host0_print
 
@@ -40,41 +53,73 @@ class CheckpointWatcher:
         template_state: Any,
         poll_s: float = 5.0,
         metrics: Optional[Any] = None,
+        chaos: Optional[Any] = None,
+        max_backoff_s: float = 30.0,
     ):
         self.manager = CheckpointManager(
             run_dir, save_every_epoch=False, async_save=False)
         self.engine = engine
         self.template = template_state
         self.poll_s = max(float(poll_s), 0.1)
+        self.max_backoff_s = max(float(max_backoff_s), self.poll_s)
         self.metrics = metrics
+        self.chaos = chaos  # FaultPlan for watcher_io drills; None = never
         # newest epoch actually serving; candidates at or below it are not
         # re-loaded (an epoch file is written once — atomic rename — so
         # same-epoch mutation is not a case worth polling for)
         self.loaded_epoch = -1
+        # transient-failure bookkeeping: polls is the chaos hook's counter,
+        # consecutive_errors drives the bounded backoff, last_error is the
+        # operator-facing diagnosis (/healthz has alive; logs have this)
+        self.polls = 0
+        self.consecutive_errors = 0
+        self.last_error: Optional[str] = None
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
+
+    @property
+    def alive(self) -> bool:
+        """True while the poll thread is running — /healthz surfaces this
+        so a replica serving stale params with a dead watcher is
+        distinguishable from one that is merely between polls."""
+        return self._thread is not None and self._thread.is_alive()
+
+    def _digest_of(self, path: str) -> str:
+        try:
+            return self.manager.file_digest(path)
+        except OSError:
+            return ""
 
     def restore_initial(self) -> int:
         """Serve the newest verified checkpoint at startup (quarantining any
         bad ones on the way, like --auto_resume); returns the loaded epoch
         (-1 = nothing verified yet — the engine serves its template params
         until the first good checkpoint lands)."""
-        state, next_epoch = self.manager.restore_latest(self.template)
+        state, next_epoch, path, digest = \
+            self.manager.restore_latest_with_provenance(self.template)
         if next_epoch:
-            self.engine.swap_state(state)
-            self.loaded_epoch = next_epoch - 1
+            epoch = next_epoch - 1
+            emit("verify_ok", epoch=epoch, path=path, digest=digest or "")
+            self.engine.swap_state(state, digest=digest or "",
+                                   generation=epoch)
+            self.loaded_epoch = epoch
+            emit("swap", epoch=epoch, digest=digest or "")
         return self.loaded_epoch
 
     def check_once(self) -> bool:
         """One poll: try candidates newer than `loaded_epoch`, newest first.
         A corrupt candidate is quarantined (`*.corrupt`) and counted as a
         rejected reload; serving continues on the current params. Returns
-        True iff a swap happened."""
+        True iff a swap happened. OSErrors propagate to `poll_once` (the
+        backoff layer); direct callers see them raw."""
+        self.polls += 1
+        if self.chaos:
+            self.chaos.maybe_fail_watcher_poll(poll=self.polls)
         for e in sorted(self.manager._epoch_checkpoints(), reverse=True):
             if e <= self.loaded_epoch:
                 break  # sorted descending: nothing newer remains
-            state = self.manager.restore_verified(
-                self.template, self.manager.epoch_path(e))
+            path = self.manager.epoch_path(e)
+            state = self.manager.restore_verified(self.template, path)
             if state is None:  # quarantined by the manager; try next-newest
                 if self.metrics is not None:
                     self.metrics.record_reload(ok=False)
@@ -82,13 +127,38 @@ class CheckpointWatcher:
                             "(quarantined); still serving "
                             f"epoch {self.loaded_epoch}")
                 continue
-            self.engine.swap_state(state)
+            digest = self._digest_of(path)
+            emit("verify_ok", epoch=e, path=path, digest=digest)
+            self.engine.swap_state(state, digest=digest, generation=e)
             self.loaded_epoch = e
+            emit("swap", epoch=e, digest=digest)
             if self.metrics is not None:
                 self.metrics.record_reload(ok=True)
             host0_print(f"[serve] hot-reloaded checkpoint epoch {e}")
             return True
         return False
+
+    def poll_once(self) -> float:
+        """`check_once` wrapped in the transient-failure policy; returns the
+        delay before the next poll. Success (or a quiet poll) resets the
+        backoff to `poll_s`; a failure doubles it, bounded by
+        `max_backoff_s` — deterministic, so tests can pin the sequence."""
+        try:
+            self.check_once()
+        except Exception as e:  # a poll hiccup must not kill serving
+            self.consecutive_errors += 1
+            self.last_error = f"{type(e).__name__}: {e}"
+            backoff = min(self.poll_s * (2 ** min(self.consecutive_errors, 6)),
+                          self.max_backoff_s)
+            host0_print(f"[serve] reload poll failed ({self.last_error}); "
+                        f"watcher backing off {backoff:.1f}s "
+                        f"(error {self.consecutive_errors}, re-arming)")
+            emit("watcher_error", error=self.last_error, poll=self.polls,
+                 backoff_s=backoff)
+            return backoff
+        self.consecutive_errors = 0
+        self.last_error = None
+        return self.poll_s
 
     # ------------------------------------------------------------- thread --
     def start(self) -> "CheckpointWatcher":
@@ -96,12 +166,9 @@ class CheckpointWatcher:
             return self
 
         def loop():
-            while not self._stop.wait(self.poll_s):
-                try:
-                    self.check_once()
-                except Exception as e:  # a poll hiccup must not kill serving
-                    host0_print(f"[serve] reload poll failed: "
-                                f"{type(e).__name__}: {e}")
+            delay = self.poll_s
+            while not self._stop.wait(delay):
+                delay = self.poll_once()
 
         self._thread = threading.Thread(target=loop, daemon=True,
                                         name="serve-reload")

@@ -29,6 +29,7 @@ from typing import Any, Optional, Tuple
 import jax
 from flax import serialization
 
+from ..scenario.events import emit
 from ..utils.logging import host0_print, is_host0
 
 
@@ -231,6 +232,7 @@ class CheckpointManager:
             # it (FileNotFoundError) — the second rename is a no-op, the
             # pod must end up with exactly one *.corrupt file
             return
+        emit("quarantine", path=path, reason=reason)
         sidecar = self.checksum_path(path)
         if os.path.exists(sidecar):
             try:
@@ -271,12 +273,24 @@ class CheckpointManager:
                 with open(tmp, "wb") as f:
                     f.write(data)
                 os.replace(tmp, path)  # atomic: no torn ckpts on preemption
-                if self._chaos is not None and epoch is not None:
-                    self._chaos.maybe_corrupt_checkpoint(path, epoch=epoch)
+                torn = (self._chaos is not None and epoch is not None
+                        and self._chaos.maybe_corrupt_checkpoint(
+                            path, epoch=epoch))
                 sc_tmp = self.checksum_path(path) + ".tmp"
                 with open(sc_tmp, "w") as f:
                     f.write(digest + "\n")
                 os.replace(sc_tmp, self.checksum_path(path))
+                # scenario evidence (env-gated no-op outside a drill): a
+                # checkpoint became visible to watchers — the S3 adoption
+                # clock starts here; a chaos-torn candidate is declared so
+                # the checker can exempt it from adoption and expect the
+                # quarantine instead
+                if epoch is not None and os.path.basename(path).startswith(
+                        "ckpt_e"):
+                    emit("publish", epoch=epoch, path=path, digest=digest,
+                         world_size=jax.process_count())
+                    if torn:
+                        emit("publish_torn", epoch=epoch, path=path)
             if meta_updates:
                 self._write_meta(**meta_updates)
             if prune_after and self.keep > 0:

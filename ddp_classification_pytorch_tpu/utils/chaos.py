@@ -33,6 +33,14 @@ drives injection hooks planted at four points:
   matching global step: the machine (trainer AND its supervise.sh) is
   gone, not just the trainer — the elastic re-formation scenario, where
   no local supervisor will ever bring the host back.
+- ``publish_corrupt`` — the serve-side sibling of ``ckpt_io``: tears the
+  PUBLISHED candidate the same way (epoch-keyed, same truncate-to-half),
+  but names the scenario under test — a serving fleet watching the run
+  dir must quarantine the candidate and keep answering on the previous
+  params (scenario/ drills assert exactly that).
+- ``watcher_io`` — the checkpoint watcher's poll raises ``OSError(EIO)``
+  on the matching poll number: a shared-fs flake mid-scan. The watcher
+  must log + back off + re-arm, never die (serve/reload.py).
 
 Ranges: ``@step=7`` (one step), ``@step=7..9`` (inclusive), ``@step=7..``
 (every step from 7 on). Host-side faults (ckpt_io / loader_io / sigterm /
@@ -65,8 +73,8 @@ from dataclasses import dataclass
 from typing import List, Optional, Tuple
 
 KINDS = ("nan_loss", "ckpt_io", "loader_io", "sigterm", "peer_dead",
-         "peer_slow", "host_lost")
-UNITS = ("step", "epoch", "batch")
+         "peer_slow", "host_lost", "publish_corrupt", "watcher_io")
+UNITS = ("step", "epoch", "batch", "poll")
 
 ENV_SPEC = "CHAOS_FAULT_SPEC"
 ENV_STATE_DIR = "CHAOS_STATE_DIR"
@@ -165,6 +173,14 @@ class FaultPlan:
             if kind in ("peer_dead", "peer_slow", "host_lost") and unit != "step":
                 raise ValueError(f"{kind} is keyed by the host-side step "
                                  f"counter; use {kind}@step=...")
+            if kind == "publish_corrupt" and unit != "epoch":
+                raise ValueError("publish_corrupt tears a published epoch "
+                                 "checkpoint; use publish_corrupt@epoch=...")
+            if kind == "watcher_io" and unit != "poll":
+                raise ValueError("watcher_io is keyed by the watcher's poll "
+                                 "counter; use watcher_io@poll=...")
+            if unit == "poll" and kind != "watcher_io":
+                raise ValueError("the poll unit belongs to watcher_io only")
             faults.append(Fault(kind, unit, lo, hi))
         return cls(faults, state_dir=state_dir, process_index=process_index)
 
@@ -252,16 +268,37 @@ class FaultPlan:
         """Checkpoint-write hook (train/checkpoint.py): tears the landed
         file by truncating it to half its bytes — the sha256 sidecar
         (computed from the intact serialization) then fails verification
-        on resume. Returns True when it fired."""
+        on resume. Returns True when it fired.
+
+        Fires for ``ckpt_io`` (resume-path drills) and its serve-side twin
+        ``publish_corrupt`` (a corrupt PUBLISHED candidate a watching
+        serving fleet must quarantine without dropping traffic)."""
         f = self.should_fire("ckpt_io", epoch=epoch)
+        label = "tore checkpoint"
+        if f is None:
+            f = self.should_fire("publish_corrupt", epoch=epoch)
+            label = "corrupted published candidate"
         if f is None:
             return False
         size = os.path.getsize(path)
         with open(path, "r+b") as fh:
             fh.truncate(max(size // 2, 1))
-        print(f"# chaos: tore checkpoint {path} ({f}): "
+        print(f"# chaos: {label} {path} ({f}): "
               f"{size} -> {max(size // 2, 1)} bytes", file=sys.stderr, flush=True)
         return True
+
+    def maybe_fail_watcher_poll(self, *, poll: int) -> None:
+        """Watcher-poll hook (serve/reload.py::CheckpointWatcher): raises
+        EIO on the matching poll number — a shared-fs flake mid-scan the
+        watcher must survive (log + bounded backoff + re-arm)."""
+        f = self.should_fire("watcher_io", poll=poll)
+        if f is not None:
+            import errno
+
+            print(f"# chaos: watcher poll {poll} fails ({f})",
+                  file=sys.stderr, flush=True)
+            raise OSError(errno.EIO, f"chaos: injected watcher poll "
+                                     f"failure ({f}) at poll={poll}")
 
     def maybe_sigterm(self, *, step: int) -> None:
         """Step-loop hook (train/loop.py): a mid-epoch preemption."""

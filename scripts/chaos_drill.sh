@@ -46,8 +46,17 @@
 # every restart and give up within its budget instead of hanging in
 # rendezvous backoff forever.
 #
+# Phase 8 (train→serve scenario, must converge to rc 0): the full
+# continuous train→serve drill via scripts/scenario.sh — an elastic
+# 2-host pod publishing into a shared run dir, 2 serve replicas under
+# offered load, with a NaN burst, a torn epoch-0 checkpoint, host 1
+# SIGKILLed mid-run (re-form + rejoin), a corrupt PUBLISHED candidate, a
+# watcher poll flake, and a deliberate replica drain during reloads —
+# then the S1–S4 invariants (verified-serve, availability floor, bounded
+# adoption, analyzer gate) machine-checked from events.jsonl.
+#
 # CPU-only, synthetic data, tiny model: runs anywhere in a few minutes.
-# Select phases with CHAOS_PHASES (default "1 2 3 4 5 6 7"); the pod
+# Select phases with CHAOS_PHASES (default "1 2 3 4 5 6 7 8"); the pod
 # phases skip gracefully when the platform cannot host two CPU processes
 # (a forced non-cpu JAX_PLATFORMS means only one host's worth of real
 # devices is available).
@@ -55,7 +64,7 @@
 set -u
 REPO=$(cd "$(dirname "$0")/.." && pwd)
 OUT=${1:-"$REPO/runs/chaos_drill"}
-PHASES=${CHAOS_PHASES:-"1 2 3 4 5 6 7"}
+PHASES=${CHAOS_PHASES:-"1 2 3 4 5 6 7 8"}
 export JAX_PLATFORMS=${JAX_PLATFORMS:-cpu}
 
 COMMON=(baseline --dataset synthetic --platform cpu --model resnet18
@@ -379,6 +388,39 @@ grep -q "rc=10" "$P7/restarts.log" \
   || fail "restarts.log never classified the rc-10 give-up"
 echo "[drill] phase 7 OK: unviable survivor set exited deterministic" \
      "rc 10 within its restart budget — no hang"
+fi
+fi
+
+# ---------------------------------------------------------------- phase 8 --
+if has_phase 8; then
+if ! pod_available; then
+  echo "[drill] phase 8 SKIPPED: the scenario drill needs the CPU" \
+       "virtual-device harness"
+else
+P8="$OUT/scenario"
+rm -rf "$P8"; mkdir -p "$P8"
+echo "[drill] phase 8: continuous train→serve scenario (scripts/scenario.sh)"
+bash "$REPO/scripts/scenario.sh" "$P8" 2>&1 | tee "$P8/drill.log"
+rc=${PIPESTATUS[0]}
+[ "$rc" -eq 0 ] || fail "phase 8 exited rc=$rc, want 0 (see $P8/drill.log)"
+grep -q "GREEN: S1 verified-serve" "$P8/drill.log" \
+  || fail "the invariant checker never declared the run green"
+[ -s "$P8/events.jsonl" ] || fail "events.jsonl missing or empty"
+grep -q '"kind": "publish_torn"' "$P8/events.jsonl" \
+  || fail "no publish_torn event — the corrupt-candidate faults never fired"
+grep -q '"kind": "quarantine"' "$P8/events.jsonl" \
+  || fail "no quarantine event — the torn candidate was never caught"
+grep -q '"kind": "watcher_error"' "$P8/events.jsonl" \
+  || fail "no watcher_error event — the watcher_io flake never fired"
+grep -q '"kind": "reform"' "$P8/events.jsonl" \
+  || fail "no reform event — the host loss never re-formed the pod"
+grep -q '"kind": "drain_begin"' "$P8/events.jsonl" \
+  || fail "no drain_begin event — the reload-during-drain window never opened"
+grep -q "rc=11" "$P8/restarts.log" \
+  || fail "no rc 11 (pod-reform) in restarts.log — the rejoin never happened"
+echo "[drill] phase 8 OK: train→serve scenario green —" \
+     "$(grep -c '"kind": "request"' "$P8/events.jsonl") requests under chaos," \
+     "all four invariants held"
 fi
 fi
 

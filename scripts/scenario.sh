@@ -1,0 +1,54 @@
+#!/usr/bin/env bash
+# Continuous train→serve chaos drill — the flagship robustness scenario
+# (scenario/; runbook: docs/operations.md "Scenario drill").
+#
+# Launches an elastic trainer pod under supervise.sh publishing verified
+# checkpoints into a shared run dir, serve replicas hot-reloading from it
+# under offered HTTP load, injects the spec's chaos timeline (NaN burst,
+# torn + corrupt-published checkpoints, host SIGKILL, watcher fs flake,
+# reload-during-drain), then machine-checks the S1–S4 invariants from the
+# recorded events.jsonl. Exits with cli.scenario's code: 0 green,
+# 1 invariant violated / process failed, 2 malformed spec.
+#
+#   bash scripts/scenario.sh                         # default drill
+#   bash scripts/scenario.sh runs/s my_spec.json     # custom out + spec
+#
+# Flags used here are locked against the cli.scenario parser by
+# tests/test_scripts_meta.py.
+set -u
+REPO=$(cd "$(dirname "$0")/.." && pwd)
+OUT=${1:-"$REPO/runs/scenario"}
+SPEC=${2:-""}
+
+if [ -z "$SPEC" ]; then
+  SPEC="$OUT/spec.json"
+  mkdir -p "$OUT"
+  # the default drill: every fault family at once — torn epoch-0 ckpt,
+  # NaN burst absorbed by the sentinel, host 1 SIGKILLed mid-run (elastic
+  # re-form + rejoin), a corrupt PUBLISHED candidate, a watcher poll
+  # flake, and a deliberate replica drain while reloads are in flight
+  cat > "$SPEC" <<'JSON'
+{
+  "trainer": {
+    "hosts": 2, "elastic": true, "min_processes": 1, "epochs": 4,
+    "fault_specs": {
+      "0": "ckpt_io@epoch=0,publish_corrupt@epoch=2",
+      "1": "nan_loss@step=2..3,host_lost@step=10"
+    }
+  },
+  "serve": {
+    "replicas": 2, "poll_s": 1.0,
+    "fault_specs": {"0": "watcher_io@poll=3"}
+  },
+  "load": {"rps": 4.0, "timeout_s": 20.0},
+  "availability": {"floor": 0.5, "window_s": 10.0, "min_samples": 3},
+  "adopt_deadline_s": 180.0,
+  "deadline_s": 900.0,
+  "timeline": [{"at": "publish:1", "action": "drain_replica", "replica": 1}]
+}
+JSON
+fi
+
+cd "$REPO"
+exec env JAX_PLATFORMS=cpu python -m ddp_classification_pytorch_tpu.cli.scenario \
+    --scenario_spec "$SPEC" --out "$OUT"

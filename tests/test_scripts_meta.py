@@ -93,10 +93,17 @@ def test_chaos_drill_flags_match_train_cli():
     load-bearing pieces (--multihost, peer_dead, CHAOS_HOST aiming, the
     FLEET_ rendezvous knobs) must stay present — a silently dropped flag
     would skip the pod drill without anyone noticing."""
+    from ddp_classification_pytorch_tpu.cli.scenario import (
+        build_parser as scenario_parser,
+    )
     from ddp_classification_pytorch_tpu.cli.train import build_parser
 
     known = set()
     for action in build_parser()._actions:
+        known.update(action.option_strings)
+    # phase 8 delegates to scripts/scenario.sh → cli.scenario; its flags
+    # are legal in the drill body too
+    for action in scenario_parser()._actions:
         known.update(action.option_strings)
     body = _script_body("chaos_drill.sh")
     # XLA_FLAGS=--xla_... is an env assignment, not a CLI flag
@@ -110,8 +117,37 @@ def test_chaos_drill_flags_match_train_cli():
                    "ckpt_e1.msgpack.corrupt",
                    # the elastic phases' load-bearing pieces
                    "host_lost@step=", "FLEET_ELASTIC=",
-                   "FLEET_MIN_PROCESSES=", "FLEET_HOST_ID="):
+                   "FLEET_MIN_PROCESSES=", "FLEET_HOST_ID=",
+                   # phase 8: the train→serve scenario and the evidence it
+                   # must find in the recorded event log
+                   "scripts/scenario.sh", "GREEN: S1 verified-serve",
+                   '"kind": "publish_torn"', '"kind": "watcher_error"',
+                   '"kind": "reform"', '"kind": "drain_begin"', "rc=11"):
         assert needle in body, f"chaos_drill.sh lost its {needle!r} phase piece"
+
+
+def test_scenario_script_flags_match_cli():
+    """scripts/scenario.sh must stay in sync with cli.scenario: every
+    --flag it passes has to exist in the scenario parser, and its default
+    spec must keep staging every fault family the drill exists to prove
+    (a silently dropped fault would hollow out phase 8)."""
+    from ddp_classification_pytorch_tpu.cli.scenario import build_parser
+
+    known = set()
+    for action in build_parser()._actions:
+        known.update(action.option_strings)
+    body = _script_body("scenario.sh")
+    assert "ddp_classification_pytorch_tpu.cli.scenario" in body
+    passed = set(re.findall(r"(?<![\w-])--[a-z_]+", body))
+    assert passed, "scenario.sh passes no flags — launcher gutted?"
+    unknown = sorted(passed - known)
+    assert not unknown, \
+        f"scenario.sh passes flags cli.scenario rejects: {unknown}"
+    for needle in ("ckpt_io@epoch=", "publish_corrupt@epoch=",
+                   "nan_loss@step=", "host_lost@step=", "watcher_io@poll=",
+                   "drain_replica", "JAX_PLATFORMS=cpu"):
+        assert needle in body, \
+            f"scenario.sh default spec lost its {needle!r} fault piece"
 
 
 def test_lint_script_flags_match_analyze_cli():

@@ -18,6 +18,8 @@ The acceptance pins:
 - SIGTERM drains gracefully: intake stops, queued work completes.
 """
 
+import glob
+import json
 import os
 import signal
 import threading
@@ -215,6 +217,174 @@ def test_hot_reload_swaps_and_quarantines_corrupt(sv, tmp_path):
     np.testing.assert_array_equal(
         f.result(timeout=30).scores,
         np.asarray(sv.predict(engine._state, np.stack(sv.imgs[2:4]))[0])[0])
+
+
+def test_swap_racing_drain_never_mixes_params_in_a_batch(sv):
+    """swap_state storms from a reloader thread while requests flow and the
+    engine finally drains: every answered Prediction must be INTERNALLY
+    consistent — its scores bitwise-equal to the direct predict under the
+    params its digest names. A batch that adopted new params mid-flight
+    (mixing two checkpoints inside one micro-batch) would answer with one
+    digest and the other params' numerics, and fail the bitwise check."""
+    import jax
+
+    img = sv.imgs[0]
+    state_b = sv.state.replace(params=jax.tree_util.tree_map(
+        lambda x: x * 1.5, sv.state.params))
+    # expected rows per digest at every bucket shape a batch might run;
+    # "A" republishes the init params under a named digest, so A/fresh
+    # share numerics while B's differ — only B-vs-(A|fresh) mixing exists
+    expected = {}
+    for name, st in (("fresh", sv.state), ("A", sv.state), ("B", state_b)):
+        rows = set()
+        for b in BUCKETS:
+            out = np.asarray(sv.predict(st, np.stack([img] * b))[0])
+            rows.update(out[i].tobytes() for i in range(b))
+        expected[name] = rows
+
+    engine = _engine(sv, batch_timeout_ms=5.0, queue_depth=32).start()
+    stop = threading.Event()
+
+    def swapper():
+        flip = False
+        while not stop.is_set():
+            if flip:
+                engine.swap_state(state_b, digest="B", generation=2)
+            else:
+                engine.swap_state(sv.state, digest="A", generation=1)
+            flip = not flip
+            time.sleep(0.002)
+
+    t = threading.Thread(target=swapper)
+    t.start()
+    futures = []
+    try:
+        for _ in range(24):
+            try:
+                futures.append(engine.submit(img))
+            except QueueFull:
+                pass
+            time.sleep(0.003)
+        # drain races the still-running swapper: the inline flush must keep
+        # the one-params-version-per-batch contract too
+        engine.drain()
+    finally:
+        stop.set()
+        t.join()
+    preds = [f.result(timeout=30) for f in futures]
+    assert preds, "no request was ever accepted"
+    for p in preds:
+        assert p.digest in expected
+        assert p.scores.tobytes() in expected[p.digest], (
+            f"scores answered under digest {p.digest!r} do not match that "
+            "checkpoint's params — a micro-batch mixed two param versions")
+
+
+def test_quarantine_double_rename_yields_exactly_one_corrupt(sv, tmp_path):
+    """The shared-run-dir race: the serving watcher AND a trainer-side
+    manager both find the same corrupt candidate and quarantine it. In
+    either order the loser's rename must be a silent no-op — the pod ends
+    with exactly ONE *.corrupt file, no crash, serving state untouched."""
+
+    def corrupt_candidate(run_dir, epoch):
+        mgr = CheckpointManager(run_dir, async_save=False)
+        mgr.save(sv.state, epoch=epoch)
+        with open(mgr.epoch_path(epoch), "r+b") as fh:
+            fh.seek(100)
+            fh.write(b"\xde\xad\xbe\xef")
+        return mgr
+
+    stub = SimpleNamespace(swap_state=lambda *a, **k: None)
+
+    # order 1: the trainer-side manager quarantines first
+    d1 = str(tmp_path / "a")
+    mgr = corrupt_candidate(d1, 1)
+    watcher = CheckpointWatcher(d1, stub, sv.state)
+    assert mgr.restore_verified(sv.state, mgr.epoch_path(1)) is None
+    assert watcher.check_once() is False  # nothing left to scan; no crash
+    assert watcher.loaded_epoch == -1
+    assert len(glob.glob(os.path.join(d1, "*.msgpack.corrupt"))) == 1
+
+    # order 2: the watcher quarantines first, the manager loses the race
+    d2 = str(tmp_path / "b")
+    mgr = corrupt_candidate(d2, 1)
+    watcher = CheckpointWatcher(d2, stub, sv.state)
+    assert watcher.check_once() is False
+    assert mgr.restore_verified(sv.state, mgr.epoch_path(1)) is None
+    # and a second rename of the SAME path (both sides committed to
+    # quarantine before either rename landed) is a no-op, not a crash
+    mgr._quarantine(mgr.epoch_path(1), "sha256 mismatch")
+    assert len(glob.glob(os.path.join(d2, "*.msgpack.corrupt"))) == 1
+    assert watcher.loaded_epoch == -1
+
+
+def test_http_healthz_and_retry_after(sv, tmp_path):
+    """The wire contract of serve/http.py: /healthz reports params
+    provenance + watcher liveness, queue-full answers 503 busy with
+    Retry-After 1 (same replica, soon), draining answers 503 draining with
+    Retry-After 5 (go elsewhere) — the distinction S2 relies on."""
+    import io
+    import urllib.request
+    from urllib.error import HTTPError
+
+    from PIL import Image
+
+    from ddp_classification_pytorch_tpu.serve.http import make_server
+
+    buf = io.BytesIO()
+    Image.fromarray(sv.imgs[0]).save(buf, format="PNG")
+    png = buf.getvalue()
+
+    engine = _engine(sv, queue_depth=1,
+                     transform=lambda img, rng: sv.imgs[0])
+    watcher = CheckpointWatcher(str(tmp_path), engine, sv.state, poll_s=0.2)
+    server = make_server(engine, 0, watcher=watcher)  # 0 = ephemeral port
+    port = server.server_address[1]
+    threading.Thread(target=server.serve_forever, daemon=True).start()
+    base = f"http://127.0.0.1:{port}"
+
+    def get(path):
+        with urllib.request.urlopen(base + path, timeout=10) as r:
+            return json.loads(r.read())
+
+    def post():
+        req = urllib.request.Request(base + "/predict", data=png,
+                                     method="POST")
+        return urllib.request.urlopen(req, timeout=30)
+
+    try:
+        health = get("/healthz")
+        assert health["ok"] is True
+        assert health["digest"] == "fresh" and health["generation"] == -1
+        assert health["watcher_alive"] is False  # built but never started
+        watcher.start()
+        assert get("/healthz")["watcher_alive"] is True
+
+        # bounded queue full (batcher not running) → 503 busy + hint
+        engine.submit(sv.imgs[0])
+        with pytest.raises(HTTPError) as exc:
+            post()
+        assert exc.value.code == 503
+        assert exc.value.headers["Retry-After"] == "1"
+        assert json.loads(exc.value.read())["state"] == "busy"
+
+        engine.start()
+        with post() as r:
+            body = json.loads(r.read())
+        assert body["digest"] == "fresh" and body["generation"] == -1
+        assert len(body["topk"]) == 3
+
+        engine.drain()
+        with pytest.raises(HTTPError) as exc:
+            post()
+        assert exc.value.code == 503
+        assert exc.value.headers["Retry-After"] == "5"
+        assert json.loads(exc.value.read())["state"] == "draining"
+        assert get("/healthz")["ok"] is False
+    finally:
+        watcher.stop()
+        server.shutdown()
+        server.server_close()
 
 
 def test_sigterm_drains_gracefully(sv):
