@@ -115,6 +115,8 @@ def lint_step_factories(factories: Optional[Iterable[str]] = None
             "ddp_classification_pytorch_tpu.train.steps:_build_step",
             "ddp_classification_pytorch_tpu.train.steps:_arcface_sharded_loss",
             "ddp_classification_pytorch_tpu.train.steps:_make_arcface_sharded_eval",
+            "ddp_classification_pytorch_tpu.train.steps:_dense_loss_fn",
+            "ddp_classification_pytorch_tpu.train.steps:make_phase_probes",
         })
     findings: List[Finding] = []
     by_module: dict = {}

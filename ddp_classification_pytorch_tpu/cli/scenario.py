@@ -77,7 +77,7 @@ def main(argv: Optional[Sequence[str]] = None) -> None:
         for f in sup.failures:
             print(f"[scenario] FAIL: {f}", file=sys.stderr)
 
-    from ..scenario.events import read_events
+    from ..obs.events import read_events
     from ..scenario.invariants import check_invariants
 
     events = read_events(events_path)

@@ -329,7 +329,7 @@ def main(argv: Optional[Sequence[str]] = None) -> None:
         server = start_server(engine, cfg.serve.port, watcher=watcher)
         host0_print(f"[serve] http on :{cfg.serve.port} "
                     "(POST /predict, GET /healthz, GET /metrics)")
-    from ..scenario.events import emit
+    from ..obs.events import emit
 
     emit("serve_ready", port=cfg.serve.port,
          epoch=(watcher.loaded_epoch if watcher is not None else -1))

@@ -1,0 +1,27 @@
+"""Unified observability spine — dependency-free telemetry for every
+subsystem (trainer, serve, fleet, scenario, bench).
+
+Three planes, one package:
+
+- `obs.registry` — Prometheus-style counters/gauges/bounded-window
+  histograms with a text-exposition exporter (`/metrics`,
+  `$OUT/metrics.prom`) and a JSON snapshot. `serve/metrics.py` is a thin
+  bridge over it; the trainer, `parallel/fleet.py`, `train/sentinel.py`
+  and `serve/reload.py` register instruments directly.
+- `obs.trace` — the `jax.profiler` step-time breakdown: a Chrome-trace
+  parser that buckets device activity into
+  `{fwd, bwd, optimizer, collectives, h2d, idle}` per
+  `StepTraceAnnotation` window, plus the host-side `SpanRecorder`
+  fallback that makes the parser and schema testable without an
+  accelerator (`bench.py --trace`).
+- `obs.events` — the machine-readable event plane (`events.jsonl`),
+  promoted from `scenario/events.py` (which remains as a compat
+  re-export). `emit()` stays env-gated and unconditionally cheap.
+
+Everything here is host-side bookkeeping: no instrument ever syncs a
+device value or appears inside a jitted program (`analysis/lint.py`
+host-sync pass stays green over the instrumented factories).
+"""
+
+from . import events, registry, trace  # noqa: F401
+from .registry import Registry  # noqa: F401

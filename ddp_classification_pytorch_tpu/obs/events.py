@@ -1,0 +1,110 @@
+"""Machine-readable event plane (`events.jsonl`) — the shared spine the
+scenario supervisor, trainer, serve replicas and fleet all write to.
+
+One JSON object per line, append-only, written by EVERY process of a
+scenario run (trainer hosts, serve replicas, the supervisor, the load
+generator) into the same file. A single `write()` of one line on a local
+filesystem is atomic for our line sizes, so concurrent appenders interleave
+whole records, never torn ones; the reader still skips an unparseable tail
+line (a process killed mid-append — exactly what the chaos drill stages).
+
+Producers inside the trainer/server call the module-level `emit()`, which
+is a no-op unless the scenario supervisor armed the process via env:
+
+- ``SCENARIO_EVENTS`` — absolute path of the shared events.jsonl;
+- ``SCENARIO_SOURCE`` — who is speaking (``trainer.h0``, ``replica1``,
+  ``supervisor``, ``loadgen``); defaults to ``pid<N>``.
+
+Production runs never set the env, so the hooks cost one dict lookup and
+change nothing — the same falsy-plan discipline as utils/chaos.py.
+
+Event vocabulary (fields beyond ts/kind/source):
+
+    publish        epoch, path, digest, world_size   trainer host 0
+    publish_torn   epoch, path                       chaos tore the candidate
+    quarantine     path, reason                      any verifier's rename
+    verify_ok      epoch, path, digest               watcher, pre-swap
+    swap           epoch, digest                     watcher, post-adopt
+    watcher_error  error, poll, backoff_s            watcher poll survived an
+                                                     fs fault (backing off)
+    serve_ready    port, epoch                       replica finished warmup
+    drain_begin    queued / drain_end                replica graceful drain
+    reform         gen, world                        fleet membership write
+    replica_start  replica, port / replica_stop      supervisor
+    request        status, replica, digest?,         load generator; status ∈
+                   generation?, code?                ok|busy|draining|refused|error
+    lint           rc                                end-of-run analyzer gate
+    scenario_start / scenario_end                    supervisor brackets
+
+Historically this lived at `scenario/events.py`; it was promoted here so
+non-scenario subsystems emit through the same spine without reaching into
+the scenario package. `scenario.events` remains a compat re-export.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from typing import Any, Dict, List
+
+ENV_EVENTS = "SCENARIO_EVENTS"
+ENV_SOURCE = "SCENARIO_SOURCE"
+
+
+class EventLog:
+    """Explicit-path appender for processes that own their identity (the
+    supervisor and its load generator); in-tree hooks use `emit()`."""
+
+    def __init__(self, path: str, source: str):
+        self.path = path
+        self.source = source
+        os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
+
+    def emit(self, kind: str, **fields: Any) -> None:
+        write_event(self.path, self.source, kind, fields)
+
+
+def write_event(path: str, source: str, kind: str, fields: Dict) -> None:
+    rec = {"ts": round(time.time(), 6), "kind": kind, "source": source}
+    rec.update(fields)
+    line = json.dumps(rec, sort_keys=True) + "\n"
+    try:
+        with open(path, "a") as f:
+            f.write(line)
+    except OSError:
+        # losing an event must never take down training or serving — the
+        # invariant checker treats a hole as missing evidence, not a crash
+        pass
+
+
+def emit(kind: str, **fields: Any) -> None:
+    """Env-gated hook for trainer/serve/fleet code: record `kind` into the
+    scenario event log IF this process runs under a scenario supervisor
+    (``SCENARIO_EVENTS`` set); free and silent otherwise."""
+    path = os.environ.get(ENV_EVENTS, "")
+    if not path:
+        return
+    source = os.environ.get(ENV_SOURCE) or f"pid{os.getpid()}"
+    write_event(path, source, kind, fields)
+
+
+def read_events(path: str) -> List[Dict]:
+    """Parse an events.jsonl; skips blank and torn lines (a producer
+    SIGKILLed mid-append leaves at most one unparseable record)."""
+    out: List[Dict] = []
+    if not os.path.exists(path):
+        return out
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rec = json.loads(line)
+            except ValueError:
+                continue
+            if isinstance(rec, dict) and "kind" in rec:
+                out.append(rec)
+    out.sort(key=lambda r: r.get("ts", 0.0))
+    return out

@@ -720,7 +720,7 @@ def write_membership(out_dir: str, generation: int, world) -> None:
     os.replace(tmp, path)
     # scenario evidence (env-gated no-op outside a drill): the membership
     # generation bump IS the re-formation event S3 tracks across rc 11
-    from ..scenario.events import emit
+    from ..obs.events import emit
 
     emit("reform", gen=int(generation), world=[int(h) for h in world])
 
@@ -825,7 +825,8 @@ class FleetCoordinator:
 
     def __init__(self, process_index: Optional[int] = None,
                  process_count: Optional[int] = None, *,
-                 out_dir: str = "", host_id: Optional[int] = None):
+                 out_dir: str = "", host_id: Optional[int] = None,
+                 registry: Any = None):
         self.process_index = (_process_index() if process_index is None
                               else int(process_index))
         self.process_count = (_process_count() if process_count is None
@@ -847,6 +848,26 @@ class FleetCoordinator:
             self._lease_ttl_s = 600.0
         # the (generation, world) the running program was built for
         self.membership = _CURRENT_MEMBERSHIP
+        # instruments (trainer passes its registry so these land in
+        # $OUT/metrics.prom; standalone use self-observes). All updates
+        # happen at lease/epoch cadence — never inside the step.
+        if registry is None:
+            from ..obs.registry import Registry
+
+            registry = Registry()
+        self._gen_gauge = registry.gauge(
+            "fleet_generation", "membership generation this program joined")
+        self._lease_age_gauge = registry.gauge(
+            "fleet_lease_age_seconds",
+            "seconds since this host last refreshed its lease")
+        self._reforms_counter = registry.counter(
+            "fleet_reforms_total",
+            "membership changes answered with PodReform (rc 11)")
+        self._aborts_counter = registry.counter(
+            "fleet_aborts_total",
+            "abort intents recorded on this host (propagated as PodAbort)")
+        self._gen_gauge.set(self.membership[0] if self.membership else 0)
+        self._last_lease_t: Optional[float] = None
 
     def note_abort(self, code: int, reason: str = "") -> None:
         """Record this host's abort intent (first one wins — the cause,
@@ -854,6 +875,7 @@ class FleetCoordinator:
         if code and not self.abort_code:
             self.abort_code = int(code)
             self.abort_reason = reason
+            self._aborts_counter.inc()
             print(f"[fleet] host {self.process_index}: abort intent "
                   f"rc {self.abort_code}"
                   + (f" ({reason})" if reason else "")
@@ -867,6 +889,13 @@ class FleetCoordinator:
         if not self.elastic:
             return
         gen = self.membership[0] if self.membership else 0
+        now = time.monotonic()
+        # staleness since the PREVIOUS refresh — a growing value between
+        # scrapes means the loop stopped reaching its lease cadence
+        self._lease_age_gauge.set(
+            now - self._last_lease_t if self._last_lease_t is not None
+            else 0.0)
+        self._last_lease_t = now
         try:
             write_lease(self.out_dir, self.host_id, generation=gen,
                         coordinator=self._coord_candidate)
@@ -915,6 +944,7 @@ class FleetCoordinator:
             raise PodAbort(code, origin=origin, local_code=self.abort_code,
                            reason=self.abort_reason)
         if reform:
+            self._reforms_counter.inc()
             world = list(self.membership[1]) if self.membership else []
             raise PodReform(
                 f"pod membership changed (running world {world}) — "

@@ -37,7 +37,7 @@ import threading
 import time
 from typing import Dict, List, Optional
 
-from .events import ENV_EVENTS, ENV_SOURCE, EventLog, read_events
+from ..obs.events import ENV_EVENTS, ENV_SOURCE, EventLog, read_events
 from .invariants import good_publishes
 from .spec import ScenarioSpec
 

@@ -13,7 +13,12 @@ no web framework, matching the repo's zero-new-deps rule:
                     → 400 on undecodable bodies
     GET  /healthz   → 200 {"ok": ..., "digest": ..., "generation": ...,
                            "watcher_alive": ..., ...metrics snapshot}
-    GET  /metrics   → 200 metrics snapshot JSON
+                      (Content-Type: application/json)
+    GET  /metrics   → 200 Prometheus text exposition of the engine's
+                      registry (serve_*, engine_*, watcher_* families;
+                      Content-Type: text/plain; version=0.0.4)
+    GET  /metrics.json → 200 legacy metrics snapshot JSON (same dict
+                      /healthz embeds)
 
 A load balancer (or the scenario supervisor) reads /healthz to tell
 degraded from dead: `ok` false means draining, `watcher_alive` false means
@@ -53,8 +58,23 @@ class ServeHandler(BaseHTTPRequestHandler):
         self.end_headers()
         self.wfile.write(body)
 
+    def _text(self, code: int, body: str, content_type: str) -> None:
+        raw = body.encode()
+        self.send_response(code)
+        self.send_header("Content-Type", content_type)
+        self.send_header("Content-Length", str(len(raw)))
+        self.end_headers()
+        self.wfile.write(raw)
+
     def do_GET(self):  # noqa: N802 (BaseHTTPRequestHandler API)
-        if self.path in ("/healthz", "/metrics"):
+        if self.path == "/metrics":
+            # Prometheus scrape endpoint: text exposition of every
+            # instrument registered against this engine's registry (the
+            # watcher shares it, so watcher_* families appear here too)
+            self._text(200, self.engine.metrics.registry.expose(),
+                       "text/plain; version=0.0.4")
+            return
+        if self.path in ("/healthz", "/metrics.json"):
             snap = self.engine.metrics.snapshot(self.engine.queue_depth)
             if self.path == "/healthz":
                 snap = {

@@ -1,15 +1,21 @@
 """Serving observability: per-request / per-batch counters and latency
 percentiles for the micro-batching engine (serve/engine.py).
 
-Everything here is host-side bookkeeping — the engine records one event per
-submit/reject/batch/reload, and `snapshot()` reduces the rolling window into
-the numbers an operator (or `bench.py --serve`) actually reads: p50/p95/p99
-end-to-end latency, requests/s, batch fill ratio (real rows ÷ padded rows —
-the cost of the bucket scheme), the per-bucket batch histogram (the evidence
-that at most len(buckets) compiled shapes ever ran), queue depth, and
-reload counts. The TensorBoard surface reuses the dependency-free writer
-from `utils/tensorboard.py`; the console line goes through the same
-`utils/logging.host0_print` the trainer uses.
+Since the obs/ spine landed this module is a thin bridge: every counter,
+gauge and the latency window live as instruments in an
+`obs.registry.Registry` (one per ServeMetrics — engines in one process
+never cross-talk), so the SAME numbers back three surfaces at once:
+
+- the legacy dict `snapshot()` (`/healthz`, `/metrics.json`, bench's
+  serve row, the console `log_line`) — keys and values unchanged;
+- the Prometheus text exposition `/metrics` serves
+  (`registry.expose()`), where the serve/engine instrument families
+  live next to the watcher's (serve/reload.py registers into the same
+  registry via `metrics.registry`);
+- TensorBoard scalar curves through the dependency-free writer.
+
+Everything is host-side bookkeeping — the engine records one event per
+submit/reject/batch/reload; nothing here ever syncs a device value.
 """
 
 from __future__ import annotations
@@ -18,6 +24,8 @@ import threading
 import time
 from collections import deque
 from typing import Dict, Optional, Sequence
+
+from ..obs.registry import Registry
 
 
 def percentile(sorted_values: Sequence[float], q: float) -> float:
@@ -29,87 +37,162 @@ def percentile(sorted_values: Sequence[float], q: float) -> float:
 
 
 class ServeMetrics:
-    """Thread-safe counters + a bounded latency window.
+    """Thread-safe counters + a bounded latency window, instrument-backed.
 
-    The window is a deque, not an unbounded list: a long-lived server must
-    not grow memory with request count, and recent-window percentiles are
-    the operationally useful ones anyway (a p99 diluted by yesterday's
-    traffic hides a regression happening now).
+    The window is a deque inside the registry histogram, not an unbounded
+    list: a long-lived server must not grow memory with request count, and
+    recent-window percentiles are the operationally useful ones anyway (a
+    p99 diluted by yesterday's traffic hides a regression happening now).
     """
 
-    def __init__(self, latency_window: int = 2048):
-        self._lock = threading.Lock()
-        self.submitted = 0
-        self.completed = 0
-        self.rejected = 0  # queue-full backpressure
-        self.batches = 0
-        self.errors = 0  # predict failures (futures carry the exception)
-        self.reloads = 0  # successful hot-reload swaps
-        self.reloads_rejected = 0  # corrupt candidates quarantined
-        self.recompiles = 0  # steady-state compiles the sentinel caught
-        self.rows_real = 0
-        self.rows_padded = 0
-        self.bucket_hist: Dict[int, int] = {}  # bucket size -> batches run
-        self._lat_ms = deque(maxlen=latency_window)
+    def __init__(self, latency_window: int = 2048,
+                 registry: Optional[Registry] = None):
+        self.registry = registry if registry is not None else Registry()
+        r = self.registry
+        # serve-facing family: the request lifecycle as clients see it
+        self._submitted = r.counter(
+            "serve_requests_total", "requests submitted to the engine")
+        self._completed = r.counter(
+            "serve_completed_total", "requests answered with a prediction")
+        self._rejected = r.counter(
+            "serve_rejected_total", "requests refused by the bounded queue")
+        self._latency = r.histogram(
+            "serve_request_latency_ms",
+            "end-to-end request latency (submit -> top-k result)",
+            window=latency_window)
+        self._queue_depth = r.gauge(
+            "serve_queue_depth", "requests waiting in the bounded queue")
+        # engine-facing family: what the micro-batcher actually did
+        self._batches = r.counter(
+            "engine_batches_total", "micro-batches dispatched to the device")
+        self._errors = r.counter(
+            "engine_errors_total", "predict failures (futures carry the "
+            "exception)")
+        self._reloads = r.counter(
+            "engine_reloads_total", "successful hot-reload swaps")
+        self._reloads_rejected = r.counter(
+            "engine_reloads_rejected_total",
+            "corrupt reload candidates quarantined")
+        self._recompiles = r.counter(
+            "engine_recompiles_total",
+            "steady-state compiles the sentinel caught")
+        self._rows_real = r.counter(
+            "engine_rows_real_total", "real rows through the jitted predict")
+        self._rows_padded = r.counter(
+            "engine_rows_padded_total", "bucket-padding rows (discarded)")
+        # per-bucket batch counters, created lazily per observed shape
+        self._bucket_counters: Dict[int, object] = {}
+        self._lock = threading.Lock()  # guards _done_t + bucket map
         self._done_t = deque(maxlen=latency_window)
+
+    # ------------------------------------------- legacy attribute surface --
+    # (tests and operator tooling read these names; each is a view over
+    # the backing instrument)
+    @property
+    def submitted(self) -> int:
+        return int(self._submitted.value)
+
+    @property
+    def completed(self) -> int:
+        return int(self._completed.value)
+
+    @property
+    def rejected(self) -> int:
+        return int(self._rejected.value)
+
+    @property
+    def batches(self) -> int:
+        return int(self._batches.value)
+
+    @property
+    def errors(self) -> int:
+        return int(self._errors.value)
+
+    @property
+    def reloads(self) -> int:
+        return int(self._reloads.value)
+
+    @property
+    def reloads_rejected(self) -> int:
+        return int(self._reloads_rejected.value)
+
+    @property
+    def recompiles(self) -> int:
+        return int(self._recompiles.value)
+
+    @property
+    def rows_real(self) -> int:
+        return int(self._rows_real.value)
+
+    @property
+    def rows_padded(self) -> int:
+        return int(self._rows_padded.value)
+
+    @property
+    def bucket_hist(self) -> Dict[int, int]:
+        with self._lock:
+            return {b: int(c.value) for b, c in self._bucket_counters.items()}
 
     # ------------------------------------------------------------- events --
     def record_submit(self) -> None:
-        with self._lock:
-            self.submitted += 1
+        self._submitted.inc()
 
     def record_reject(self) -> None:
-        with self._lock:
-            self.rejected += 1
+        self._rejected.inc()
 
     def record_batch(self, bucket: int, n_real: int,
                      latencies_ms: Sequence[float]) -> None:
         now = time.monotonic()
+        self._batches.inc()
+        self._completed.inc(n_real)
+        self._rows_real.inc(n_real)
+        self._rows_padded.inc(bucket - n_real)
         with self._lock:
-            self.batches += 1
-            self.completed += n_real
-            self.rows_real += n_real
-            self.rows_padded += bucket - n_real
-            self.bucket_hist[bucket] = self.bucket_hist.get(bucket, 0) + 1
+            counter = self._bucket_counters.get(bucket)
+            if counter is None:
+                counter = self.registry.counter(
+                    "engine_bucket_batches_total",
+                    "micro-batches run at each padded bucket shape",
+                    labels={"bucket": str(int(bucket))})
+                self._bucket_counters[bucket] = counter
             for lat in latencies_ms:
-                self._lat_ms.append(float(lat))
                 self._done_t.append(now)
+        counter.inc()
+        for lat in latencies_ms:
+            self._latency.observe(float(lat))
 
     def record_error(self, n: int = 1) -> None:
-        with self._lock:
-            self.errors += n
+        self._errors.inc(n)
 
     def record_reload(self, ok: bool) -> None:
-        with self._lock:
-            if ok:
-                self.reloads += 1
-            else:
-                self.reloads_rejected += 1
+        if ok:
+            self._reloads.inc()
+        else:
+            self._reloads_rejected.inc()
 
     def record_recompile(self, n: int = 1) -> None:
         """Steady-state compile(s) observed by the engine's sentinel — each
         one stalled a micro-batch for a full XLA compile."""
-        with self._lock:
-            self.recompiles += n
+        self._recompiles.inc(n)
 
     # ----------------------------------------------------------- snapshot --
     def snapshot(self, queue_depth: Optional[int] = None) -> Dict:
+        lat = sorted(self._latency.values())
         with self._lock:
-            lat = sorted(self._lat_ms)
             done = list(self._done_t)
-            out = {
-                "requests": self.submitted,
-                "completed": self.completed,
-                "rejected": self.rejected,
-                "batches": self.batches,
-                "errors": self.errors,
-                "reloads": self.reloads,
-                "reloads_rejected": self.reloads_rejected,
-                "recompiles": self.recompiles,
-                "bucket_hist": dict(self.bucket_hist),
-                "fill_ratio": round(
-                    self.rows_real / max(self.rows_real + self.rows_padded, 1), 4),
-            }
+        out = {
+            "requests": self.submitted,
+            "completed": self.completed,
+            "rejected": self.rejected,
+            "batches": self.batches,
+            "errors": self.errors,
+            "reloads": self.reloads,
+            "reloads_rejected": self.reloads_rejected,
+            "recompiles": self.recompiles,
+            "bucket_hist": self.bucket_hist,
+            "fill_ratio": round(
+                self.rows_real / max(self.rows_real + self.rows_padded, 1), 4),
+        }
         out["p50_ms"] = round(percentile(lat, 50), 3)
         out["p95_ms"] = round(percentile(lat, 95), 3)
         out["p99_ms"] = round(percentile(lat, 99), 3)
@@ -118,6 +201,7 @@ class ServeMetrics:
         out["requests_per_sec"] = round((len(done) - 1) / span, 2) if span > 0 else 0.0
         if queue_depth is not None:
             out["queue_depth"] = queue_depth
+            self._queue_depth.set(queue_depth)
         return out
 
     def log_line(self, queue_depth: Optional[int] = None) -> str:

@@ -36,7 +36,8 @@ from __future__ import annotations
 import threading
 from typing import Any, Optional
 
-from ..scenario.events import emit
+from ..obs.events import emit
+from ..obs.registry import Registry
 from ..train.checkpoint import CheckpointManager
 from ..utils.logging import host0_print
 
@@ -76,6 +77,24 @@ class CheckpointWatcher:
         self.last_error: Optional[str] = None
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
+        # watcher instruments live in the ENGINE's registry when metrics are
+        # wired (so /metrics exposes them next to serve_*/engine_*); a
+        # standalone watcher still self-observes into a private registry
+        registry = metrics.registry if (
+            metrics is not None and hasattr(metrics, "registry")
+        ) else Registry()
+        self._polls_total = registry.counter(
+            "watcher_polls_total", "reload-dir polls attempted")
+        self._errors_total = registry.counter(
+            "watcher_errors_total", "polls that hit an fs fault (backed off)")
+        self._swaps_total = registry.counter(
+            "watcher_swaps_total", "verified checkpoints hot-swapped in")
+        self._quarantines_total = registry.counter(
+            "watcher_quarantines_total",
+            "corrupt candidates renamed *.corrupt during a poll")
+        self._backoff_gauge = registry.gauge(
+            "watcher_backoff_seconds",
+            "current error backoff (0 = healthy cadence)")
 
     @property
     def alive(self) -> bool:
@@ -113,6 +132,7 @@ class CheckpointWatcher:
         True iff a swap happened. OSErrors propagate to `poll_once` (the
         backoff layer); direct callers see them raw."""
         self.polls += 1
+        self._polls_total.inc()
         if self.chaos:
             self.chaos.maybe_fail_watcher_poll(poll=self.polls)
         for e in sorted(self.manager._epoch_checkpoints(), reverse=True):
@@ -121,6 +141,7 @@ class CheckpointWatcher:
             path = self.manager.epoch_path(e)
             state = self.manager.restore_verified(self.template, path)
             if state is None:  # quarantined by the manager; try next-newest
+                self._quarantines_total.inc()
                 if self.metrics is not None:
                     self.metrics.record_reload(ok=False)
                 host0_print(f"[serve] reload candidate epoch {e} rejected "
@@ -132,6 +153,7 @@ class CheckpointWatcher:
             self.engine.swap_state(state, digest=digest, generation=e)
             self.loaded_epoch = e
             emit("swap", epoch=e, digest=digest)
+            self._swaps_total.inc()
             if self.metrics is not None:
                 self.metrics.record_reload(ok=True)
             host0_print(f"[serve] hot-reloaded checkpoint epoch {e}")
@@ -155,9 +177,12 @@ class CheckpointWatcher:
                         f"(error {self.consecutive_errors}, re-arming)")
             emit("watcher_error", error=self.last_error, poll=self.polls,
                  backoff_s=backoff)
+            self._errors_total.inc()
+            self._backoff_gauge.set(backoff)
             return backoff
         self.consecutive_errors = 0
         self.last_error = None
+        self._backoff_gauge.set(0.0)
         return self.poll_s
 
     # ------------------------------------------------------------- thread --

@@ -29,7 +29,7 @@ from typing import Any, Optional, Tuple
 import jax
 from flax import serialization
 
-from ..scenario.events import emit
+from ..obs.events import emit
 from ..utils.logging import host0_print, is_host0
 
 

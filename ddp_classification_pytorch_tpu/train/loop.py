@@ -48,6 +48,10 @@ from ..data.imagefolder import ImageFolderDataset
 from ..data.native import NativeBatcher
 from ..data.synthetic import SyntheticDataset
 from ..data.transforms import build_transform
+from ..obs.registry import Registry
+# the tunneled-TPU profiler guard lives in obs/trace.py so bench and the
+# trainer share one gate; the historical name stays importable from here
+from ..obs.trace import profiling_unsupported as _profiling_unsupported
 from ..ops.nested import best_k
 from ..parallel import fleet as fleetlib
 from ..parallel import mesh as meshlib
@@ -143,19 +147,6 @@ def build_datasets(cfg: Config) -> Tuple[Any, Any]:
         f"dataset {d.dataset!r} has a transform preset but no build branch")
 
 
-def _profiling_unsupported() -> bool:
-    """jax.profiler.start_trace wedges tunneled TPU plugins (observed: the
-    whole PJRT client hangs until the lease expires). Gate it off there —
-    but only there: a CPU backend profiles fine even when the tunnel env
-    vars are present (the relay is not in the path). Callers run after the
-    backend is initialized (the Trainer builds its mesh first), so
-    default_backend() does not trigger a fresh init here."""
-    import os
-
-    if jax.default_backend() == "cpu":
-        return False
-    return bool(os.environ.get("PALLAS_AXON_POOL_IPS")) or (
-        os.environ.get("JAX_PLATFORMS", "") == "axon")
 
 
 class Trainer:
@@ -185,6 +176,22 @@ class Trainer:
                                            process_index=jax.process_index())
         if self.chaos:
             host0_print(f"[chaos] fault plan active: {self.chaos}")
+        # observability spine: ONE registry per Trainer; the sentinel and
+        # fleet register their instruments into it, and host 0 atomically
+        # rewrites $OUT/metrics.prom at the log cadence + epoch end — a
+        # scrape-by-file surface with no server and no hot-path cost
+        # (updates happen only at existing host-sync points)
+        self.obs = Registry()
+        self._steps_counter = self.obs.counter(
+            "train_steps_total", "optimizer steps dispatched")
+        self._epochs_counter = self.obs.counter(
+            "train_epochs_total", "epochs completed (train+eval+save cycle)")
+        self._loss_gauge = self.obs.gauge(
+            "train_loss", "mean train loss of the last completed epoch")
+        self._val_top1_gauge = self.obs.gauge(
+            "val_top1", "top-1 accuracy at the last eval")
+        self._epoch_seconds_gauge = self.obs.gauge(
+            "train_epoch_seconds", "wall seconds of the last epoch cycle")
         # pod coordination (parallel/fleet.py): epoch-boundary abort
         # propagation + SIGTERM deferral, multi-process runs only — a
         # single-process Trainer keeps today's behavior bit-for-bit.
@@ -193,13 +200,15 @@ class Trainer:
         # recovered peer's fresh lease (PodReform) at epoch boundaries.
         elastic = fleetlib.elastic_enabled() and bool(cfg.run.out_dir)
         self.fleet = (fleetlib.FleetCoordinator(out_dir=cfg.run.out_dir
-                                                if elastic else "")
+                                                if elastic else "",
+                                                registry=self.obs)
                       if jax.process_count() > 1 or elastic else None)
         if self.fleet is not None and jax.process_count() > 1:
             self._defer_sigterm_to_epoch_boundary()
         # non-finite step policy: skip counting + rc-8 escalation
         # (train/sentinel.py); the streak carries across epochs
-        self.sentinel = StepSentinel(cfg.run.max_bad_steps)
+        self.sentinel = StepSentinel(cfg.run.max_bad_steps,
+                                     registry=self.obs)
         # recompile guard (analysis/compile_sentinel.py): armed by run()
         # once the first eval'd epoch completes — by then every steady-state
         # program (train step, eval step, checkpoint gather) has compiled,
@@ -343,6 +352,14 @@ class Trainer:
                 raise
             self.fleet.note_abort(SentinelDiverged.exit_code, str(e))
 
+    def _write_prom(self) -> None:
+        """Atomically rewrite ``$OUT/metrics.prom`` (host 0 only; inert
+        without an out_dir). Called at the log cadence and epoch end —
+        existing host-sync points, so the scrape file adds no new sync."""
+        if self.cfg.run.out_dir and is_host0():
+            self.obs.write_prom(
+                os.path.join(self.cfg.run.out_dir, "metrics.prom"))
+
     # -------------------------------------------------------------- profile --
     def _setup_profiler(self) -> None:
         """Resolve the jax.profiler window once (SURVEY §5 tracing row)."""
@@ -393,6 +410,7 @@ class Trainer:
                 self.state, metrics = self.train_step(self.state, *batch)
                 self._maybe_profile_stop(epoch, step, metrics)
                 n_batches += 1
+                self._steps_counter.inc()  # host-side int; no device touch
                 sums = metrics if sums is None else jax.tree_util.tree_map(
                     jax.numpy.add, sums, metrics)
                 # device scalar only — the sentinel syncs it at flush points
@@ -426,6 +444,9 @@ class Trainer:
                         # warn-only here — strict enforcement waits for the
                         # epoch boundary so a pod never aborts mid-collective
                         self.compile_sentinel.check(strict=False)
+                    # refresh the scrape file on the same cadence (atomic
+                    # rewrite; host 0 only)
+                    self._write_prom()
         finally:
             # a mid-epoch exception (divergence, injected fault, loader IO)
             # must stop and join the stager thread — a leaked stager would
@@ -536,6 +557,12 @@ class Trainer:
                     self.fleet.check()
                 val_m = self.evaluate() if (epoch + 1) % cfg.run.eval_every == 0 else {}
                 last = {**train_m, **val_m, "epoch_time": time.time() - t0}
+                self._epochs_counter.inc()
+                self._loss_gauge.set(last.get("loss", 0.0))
+                if "val_top1" in last:
+                    self._val_top1_gauge.set(last["val_top1"])
+                self._epoch_seconds_gauge.set(last["epoch_time"])
+                self._write_prom()
                 host0_print(
                     f"[epoch {epoch}] " + " ".join(f"{k}={v:.4f}" for k, v in last.items())
                 )
@@ -565,8 +592,20 @@ class Trainer:
             # sentinel divergence, SIGTERM — must release the pxla DEBUG
             # logger; disarm is idempotent (refcounted module handler)
             self.compile_sentinel.disarm()
+            # and must neither leak an in-flight profiler trace (a rc 8 /
+            # PodAbort / PodReform exit mid-capture would leave the backend
+            # tracing into a dead run dir) ...
+            if self._prof_active:
+                try:
+                    jax.profiler.stop_trace()
+                except Exception:
+                    pass  # teardown must not mask the original exception
+                self._prof_active = False
+            # ... nor drop buffered tensorboard scalars (close flushes;
+            # idempotent, so the normal path needs no second call)
+            if self.tb is not None:
+                self.tb.close()
         self.ckpt.wait()  # land any in-flight async checkpoint before returning
         self._heartbeat.stop()
-        if self.tb is not None:
-            self.tb.close()
+        self._write_prom()  # final scrape snapshot reflects the last epoch
         return last

@@ -23,8 +23,9 @@ This module is the policy layer on top of that flag:
 
 from __future__ import annotations
 
-from typing import Any, Callable, List
+from typing import Any, Callable, List, Optional
 
+from ..obs.registry import Registry
 from ..utils.logging import host0_print
 
 
@@ -43,12 +44,24 @@ class StepSentinel:
     deliberately carries across epoch boundaries."""
 
     def __init__(self, max_bad_steps: int,
-                 log: Callable[[str], None] = host0_print):
+                 log: Callable[[str], None] = host0_print,
+                 registry: Optional[Registry] = None):
         self.max_bad_steps = int(max_bad_steps)
         self.skipped_total = 0
         self.streak = 0  # consecutive skips, across flush windows/epochs
         self._log = log
         self._pending: List[Any] = []  # device scalars, not yet synced
+        # instruments update only in flush() — already a host-sync point,
+        # so nothing new touches the hot path
+        registry = registry if registry is not None else Registry()
+        self._skipped_counter = registry.counter(
+            "sentinel_skipped_steps_total",
+            "non-finite steps replaced by the identity update")
+        self._divergence_counter = registry.counter(
+            "sentinel_divergence_total",
+            "times the consecutive-skip streak hit max_bad_steps (rc 8)")
+        self._streak_gauge = registry.gauge(
+            "sentinel_streak", "current consecutive-skip streak")
 
     def observe(self, step_ok: Any) -> None:
         """Record one step's `step_ok` flag (a device scalar — NOT synced
@@ -71,10 +84,13 @@ class StepSentinel:
                 self.skipped_total += 1
                 window_skips += 1
         if window_skips:
+            self._skipped_counter.inc(window_skips)
             self._log(f"[sentinel] skipped {window_skips} non-finite "
                       f"step(s) (total {self.skipped_total}, "
                       f"consecutive {self.streak})")
+        self._streak_gauge.set(self.streak)
         if 0 < self.max_bad_steps <= self.streak:
+            self._divergence_counter.inc()
             raise SentinelDiverged(
                 f"{self.streak} consecutive non-finite steps "
                 f"(max_bad_steps={self.max_bad_steps}) — the skip-step "

@@ -40,7 +40,7 @@ echo "   docs/performance.md 'Measurement variance')" >&2
 # input_dtype evidence — first TPU capture owed)
 # --serve: also capture the serving engine's serve_latency row (p50/p99 +
 # req/s + bucket histogram — first TPU capture owed; docs/serving.md)
-python bench.py --e2e --serve > "$out/bench.json" 2> "$out/bench.log"
+python bench.py --e2e --serve --trace > "$out/bench.json" 2> "$out/bench.log"
 rc=$?
 tail -1 "$out/bench.json"
 if [ $rc -ne 0 ]; then

@@ -1,0 +1,425 @@
+"""Observability spine (obs/): registry semantics + exposition, atomic
+scrape-file rewrite, Chrome-trace parsing, the SpanRecorder fallback, and
+the promoted event plane's compat surface.
+
+The registry tests pin the operational contracts the instruments are
+trusted for: thread-safe counting, quantiles bit-identical to the legacy
+ServeMetrics estimator (so `/metrics` and `/metrics.json` can never
+disagree about p99), deterministic exposition (golden-testable), and a
+`write_prom` a concurrent scraper can read mid-rewrite without ever seeing
+a torn file. The trace tests run the SAME parser bench's --trace path uses
+over a checked-in fixture shaped like a real CPU capture — known bucket
+sums, unknown-op-goes-to-idle, window clipping, per-lane overlap union.
+"""
+
+import gzip
+import json
+import os
+import threading
+
+import pytest
+
+from ddp_classification_pytorch_tpu.obs import events as obs_events
+from ddp_classification_pytorch_tpu.obs import trace as tracelib
+from ddp_classification_pytorch_tpu.obs.registry import Registry
+
+FIXTURE = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                       "data", "fixture.trace.json")
+
+
+# ----------------------------------------------------------------- registry --
+
+def test_counter_concurrent_increments():
+    reg = Registry()
+    c = reg.counter("t_total", "concurrent counter")
+
+    def worker():
+        for _ in range(1000):
+            c.inc()
+
+    threads = [threading.Thread(target=worker) for _ in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert c.value == 8000
+
+
+def test_counter_rejects_negative_and_type_mismatch():
+    reg = Registry()
+    c = reg.counter("a_total", "x")
+    with pytest.raises(ValueError):
+        c.inc(-1)
+    # re-registration with the same kind returns the SAME instrument
+    assert reg.counter("a_total", "x") is c
+    # ... but a different kind under the same name is a hard error
+    with pytest.raises(ValueError):
+        reg.gauge("a_total", "x")
+    with pytest.raises(ValueError):
+        reg.counter("bad name", "x")
+
+
+def test_gauge_set_inc_dec():
+    reg = Registry()
+    g = reg.gauge("depth", "x")
+    g.set(5)
+    g.inc(2)
+    g.dec(3)
+    assert g.value == 4.0
+
+
+def test_histogram_quantiles_match_legacy_percentile():
+    """The registry quantile estimator must be bit-identical to the
+    `serve/metrics.py::percentile` the JSON snapshot always reported —
+    otherwise /metrics and /metrics.json disagree about the same window."""
+    from ddp_classification_pytorch_tpu.serve.metrics import percentile
+
+    reg = Registry()
+    h = reg.histogram("lat_ms", "x", window=64)
+    data = [float(v) for v in
+            [3, 1, 4, 1, 5, 9, 2, 6, 5, 3, 5, 8, 9, 7, 9, 3, 2, 3, 8, 4]]
+    for v in data:
+        h.observe(v)
+    window = sorted(h.values())
+    for q, pct in ((0.5, 50), (0.95, 95), (0.99, 99)):
+        assert h.quantile(q) == percentile(window, pct), q
+    assert h.count == len(data)
+    assert h.sum == sum(data)
+
+
+def test_histogram_window_is_bounded_but_totals_are_not():
+    reg = Registry()
+    h = reg.histogram("w_ms", "x", window=4)
+    for v in range(10):
+        h.observe(float(v))
+    assert h.values() == [6.0, 7.0, 8.0, 9.0]  # bounded window
+    assert h.count == 10 and h.sum == 45.0     # monotonic all-time totals
+
+
+def test_exposition_golden():
+    """Deterministic exposition: sorted families, one HELP/TYPE block each,
+    label escaping, summary shape for histograms."""
+    reg = Registry()
+    reg.counter("req_total", "requests", labels={"code": "200"}).inc(3)
+    reg.counter("req_total", "requests", labels={"code": "503"}).inc()
+    reg.gauge("depth", "queue depth").set(2)
+    h = reg.histogram("lat_ms", "latency", window=16)
+    h.observe(1.0)
+    h.observe(3.0)
+    assert reg.expose() == (
+        "# HELP depth queue depth\n"
+        "# TYPE depth gauge\n"
+        "depth 2\n"
+        "# HELP lat_ms latency\n"
+        "# TYPE lat_ms summary\n"
+        'lat_ms{quantile="0.5"} 1\n'
+        'lat_ms{quantile="0.95"} 3\n'
+        'lat_ms{quantile="0.99"} 3\n'
+        "lat_ms_sum 4\n"
+        "lat_ms_count 2\n"
+        "# HELP req_total requests\n"
+        "# TYPE req_total counter\n"
+        'req_total{code="200"} 3\n'
+        'req_total{code="503"} 1\n'
+    )
+
+
+def test_snapshot_maps_samples_to_values():
+    reg = Registry()
+    reg.counter("a_total", "x").inc(2)
+    reg.gauge("g", "x").set(1.5)
+    snap = reg.snapshot()
+    assert snap["a_total"] == 2
+    assert snap["g"] == 1.5
+
+
+def test_write_prom_atomic_under_concurrent_reads(tmp_path):
+    """A scraper reading the file while the writer loops must always see a
+    COMPLETE exposition (the final family line present) — torn reads would
+    mean os.replace is not being used or the tmp file leaked into place."""
+    reg = Registry()
+    c = reg.counter("rewrites_total", "x")
+    reg.gauge("zz_last", "sentinel family, sorts last").set(1)
+    path = str(tmp_path / "metrics.prom")
+    reg.write_prom(path)
+    stop = threading.Event()
+
+    def writer():
+        while not stop.is_set():
+            c.inc()
+            reg.write_prom(path)
+
+    t = threading.Thread(target=writer)
+    t.start()
+    try:
+        for _ in range(300):
+            with open(path) as f:
+                body = f.read()
+            # complete snapshot: ends with the lexicographically-last
+            # family's sample line, and the counter line parses
+            assert body.endswith("zz_last 1\n"), body[-80:]
+            lines = [ln for ln in body.splitlines()
+                     if ln.startswith("rewrites_total ")]
+            assert len(lines) == 1 and float(lines[0].split()[1]) >= 0
+    finally:
+        stop.set()
+        t.join()
+    assert not [p for p in os.listdir(tmp_path) if p.endswith(".tmp")]
+
+
+# -------------------------------------------------------------------- trace --
+
+def test_classify_table():
+    assert tracelib.classify("all-reduce.5") == "collectives"
+    assert tracelib.classify("ReduceScatter-start") == "collectives"
+    assert tracelib.classify("TransferToDevice") == "h2d"
+    assert tracelib.classify("copy-start.3") == "h2d"
+    assert tracelib.classify("transpose(dot.7)") == "bwd"
+    assert tracelib.classify("gradients/conv1") == "bwd"
+    assert tracelib.classify("adamw.update") == "optimizer"
+    assert tracelib.classify("forward/block1") == "fwd"
+    # exact bucket names map to themselves (the SpanRecorder contract)
+    for b in tracelib.BUCKETS:
+        assert tracelib.classify(b) == b
+    # unknown ops are NOT guessed — they become idle via the remainder
+    assert tracelib.classify("dot.3") is None
+    assert tracelib.classify("reduce-window.2") is None
+    assert tracelib.classify("fusion.12") is None
+
+
+def test_parse_fixture_trace():
+    """The checked-in fixture (shaped like a real CPU `.trace.json.gz`
+    payload) parses to known per-step sums: overlapping same-lane events
+    union, a window-straddling event clips, unknown ops land in idle, and
+    the six buckets sum to the wall time exactly."""
+    with open(FIXTURE) as f:
+        steps = tracelib.parse_chrome_trace(json.load(f))
+    assert [s["step"] for s in steps] == [0, 1]
+    s0, s1 = steps
+    assert s0["step_ms"] == pytest.approx(10.0)
+    # all-reduce.5 [1500,3500] and .6 [2000,3000] share a lane → union 2 ms;
+    # all-gather.1 [500,1500] clips to the window start → +0.5 ms
+    assert s0["collectives"] == pytest.approx(2.5)
+    assert s0["h2d"] == pytest.approx(1.0)
+    assert s0["fwd"] == 0.0 and s0["bwd"] == 0.0 and s0["optimizer"] == 0.0
+    assert s0["idle"] == pytest.approx(6.5)  # dot.3 (unknown) → remainder
+    assert s1["step_ms"] == pytest.approx(8.0)
+    assert s1["bwd"] == pytest.approx(2.0)
+    assert s1["optimizer"] == pytest.approx(1.0)
+    assert s1["idle"] == pytest.approx(5.0)  # reduce-window.2 is unknown
+    for s in steps:
+        assert sum(s[b] for b in tracelib.BUCKETS) == pytest.approx(
+            s["step_ms"])
+
+
+def test_aggregate_means_and_empty():
+    with open(FIXTURE) as f:
+        agg = tracelib.aggregate(tracelib.parse_chrome_trace(json.load(f)))
+    assert agg["n_steps"] == 2
+    assert agg["step_ms"] == pytest.approx(9.0)
+    assert agg["collectives"] == pytest.approx(1.25)
+    assert tracelib.aggregate([]) == {}
+
+
+def test_find_trace_file_and_gz_roundtrip(tmp_path):
+    """find_trace_file walks the jax.profiler layout and load_chrome_trace
+    is gzip-aware — the exact path bench's --trace capture goes through."""
+    d = tmp_path / "plugins" / "profile" / "2026_08_05"
+    d.mkdir(parents=True)
+    with open(FIXTURE, "rb") as f:
+        payload = f.read()
+    gz = d / "host.trace.json.gz"
+    with gzip.open(gz, "wb") as f:
+        f.write(payload)
+    assert tracelib.find_trace_file(str(tmp_path)) == str(gz)
+    steps = tracelib.breakdown_from_trace_dir(str(tmp_path))
+    assert [s["step"] for s in steps] == [0, 1]
+    assert tracelib.find_trace_file(str(tmp_path / "plugins" / "empty")) is None
+    assert tracelib.breakdown_from_trace_dir(str(tmp_path / "nope")) == []
+
+
+def test_span_recorder_roundtrip():
+    """Host-measured phases → synthetic trace → the SAME parser → the same
+    numbers back, with idle as the unattributed remainder."""
+    rec = tracelib.SpanRecorder()
+    rec.add_step(0, 0.010, {"fwd": 0.004, "bwd": 0.003, "optimizer": 0.001})
+    rec.add_step(1, 0.012, {"fwd": 0.005, "bwd": 0.004, "optimizer": 0.001})
+    steps = rec.breakdown()
+    assert [s["step"] for s in steps] == [0, 1]
+    assert steps[0]["fwd"] == pytest.approx(4.0)
+    assert steps[0]["idle"] == pytest.approx(2.0)
+    agg = tracelib.aggregate(steps)
+    assert agg["fwd"] == pytest.approx(4.5)
+    assert sum(agg[b] for b in tracelib.BUCKETS) == pytest.approx(
+        agg["step_ms"], rel=1e-6)
+
+
+def test_span_recorder_clips_overflowing_phases():
+    """A probe mis-measurement larger than the step window must clip — the
+    buckets can never sum past the wall time."""
+    rec = tracelib.SpanRecorder()
+    rec.add_step(0, 0.005, {"fwd": 0.004, "bwd": 0.004, "optimizer": 0.002})
+    (s,) = rec.breakdown()
+    assert s["fwd"] == pytest.approx(4.0)
+    assert s["bwd"] == pytest.approx(1.0)  # clipped at the window edge
+    assert s["optimizer"] == 0.0 and s["idle"] == 0.0
+    assert sum(s[b] for b in tracelib.BUCKETS) == pytest.approx(s["step_ms"])
+
+
+def test_span_recorder_rejects_unknown_phase():
+    rec = tracelib.SpanRecorder()
+    with pytest.raises(ValueError):
+        rec.add_step(0, 0.01, {"fwdd": 0.001})
+    with pytest.raises(ValueError):
+        rec.add_step(0, 0.01, {"idle": 0.001})  # idle is derived, not fed
+
+
+# ------------------------------------------------------------- event plane --
+
+def test_scenario_events_is_compat_reexport():
+    """The promotion must keep every historical `scenario.events` name
+    bound to the SAME objects — env-gated emitters registered against one
+    module must be visible through the other."""
+    from ddp_classification_pytorch_tpu.scenario import events as compat
+
+    for name in ("ENV_EVENTS", "ENV_SOURCE", "EventLog", "emit",
+                 "read_events", "write_event"):
+        assert getattr(compat, name) is getattr(obs_events, name), name
+
+
+def test_emit_gated_and_readable(tmp_path, monkeypatch):
+    path = str(tmp_path / "events.jsonl")
+    monkeypatch.delenv(obs_events.ENV_EVENTS, raising=False)
+    obs_events.emit("swap", epoch=3)  # ungated: must be a no-op
+    assert not os.path.exists(path)
+    monkeypatch.setenv(obs_events.ENV_EVENTS, path)
+    monkeypatch.setenv(obs_events.ENV_SOURCE, "test")
+    obs_events.emit("swap", epoch=3)
+    (rec,) = obs_events.read_events(path)
+    assert rec["kind"] == "swap" and rec["epoch"] == 3
+    assert rec["source"] == "test"
+
+
+# ------------------------------------------------------- serve wire surface --
+
+class _StubEngine:
+    """Just enough engine for the HTTP layer: metrics + health attrs."""
+
+    def __init__(self):
+        from ddp_classification_pytorch_tpu.serve.metrics import ServeMetrics
+
+        self.metrics = ServeMetrics()
+        self.queue_depth = 0
+        self.closed = False
+        self.params_digest = "d" * 8
+        self.params_generation = 1
+
+
+def _get(port, path):
+    """One HTTP/1.0 exchange (the stdlib handler closes per response)."""
+    import http.client
+
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=10)
+    try:
+        conn.request("GET", path)
+        r = conn.getresponse()
+        return r.status, r.getheader("Content-Type"), r.read().decode()
+    finally:
+        conn.close()
+
+
+def test_http_metrics_exposition_and_json(tmp_path):
+    """GET /metrics serves Prometheus text exposition (versioned
+    Content-Type) carrying at least one counter from each owning family —
+    serve_*, engine_*, and the watcher's watcher_* (registered into the
+    same registry at construction) — while /metrics.json preserves the
+    legacy dict and /healthz stays JSON. The wire-contract acceptance."""
+    from ddp_classification_pytorch_tpu.serve.http import make_server
+    from ddp_classification_pytorch_tpu.serve.reload import CheckpointWatcher
+
+    engine = _StubEngine()
+    engine.metrics.record_submit()
+    # constructing the watcher registers the watcher_* family into the
+    # engine's registry — no poll thread needed for the exposition
+    watcher = CheckpointWatcher(str(tmp_path), engine, template_state=None,
+                                metrics=engine.metrics)
+    server = make_server(engine, 0)
+    port = server.server_address[1]
+    threading.Thread(target=server.serve_forever, daemon=True).start()
+    try:
+        status, ctype, body = _get(port, "/metrics")
+        assert status == 200
+        assert ctype == "text/plain; version=0.0.4"
+        assert "# TYPE serve_requests_total counter" in body
+        assert "serve_requests_total 1" in body
+        assert "# TYPE engine_batches_total counter" in body
+        assert "# TYPE watcher_polls_total counter" in body
+        status, ctype, body = _get(port, "/metrics.json")
+        assert status == 200 and ctype == "application/json"
+        snap = json.loads(body)
+        assert snap["requests"] == 1 and "p99_ms" in snap
+        status, ctype, body = _get(port, "/healthz")
+        assert status == 200 and ctype == "application/json"
+        health = json.loads(body)
+        assert health["ok"] is True and health["digest"] == "d" * 8
+    finally:
+        server.shutdown()
+        server.server_close()
+    assert watcher.alive is False
+
+
+def test_serve_metrics_registry_bridge_preserves_legacy_snapshot():
+    """The instrument-backed ServeMetrics must report the EXACT legacy
+    snapshot keys/values (bench's serve row and /healthz key on them)."""
+    from ddp_classification_pytorch_tpu.serve.metrics import ServeMetrics
+
+    m = ServeMetrics(latency_window=8)
+    m.record_submit()
+    m.record_submit()
+    m.record_reject()
+    m.record_batch(4, 2, [1.0, 2.0])
+    m.record_error()
+    m.record_reload(ok=True)
+    m.record_reload(ok=False)
+    m.record_recompile()
+    s = m.snapshot(queue_depth=5)
+    assert s["requests"] == 2 and s["completed"] == 2 and s["rejected"] == 1
+    assert s["batches"] == 1 and s["errors"] == 1
+    assert s["reloads"] == 1 and s["reloads_rejected"] == 1
+    assert s["recompiles"] == 1
+    assert s["bucket_hist"] == {4: 1}
+    assert s["fill_ratio"] == 0.5
+    assert s["p50_ms"] == 1.0 and s["p99_ms"] == 2.0
+    assert s["queue_depth"] == 5
+    # and the same numbers exposed through the registry
+    exp = m.registry.expose()
+    assert "engine_rows_padded_total 2" in exp
+    assert 'engine_bucket_batches_total{bucket="4"} 1' in exp
+    assert "serve_queue_depth 5" in exp
+
+
+def test_watcher_instruments_count_polls_and_backoff(tmp_path):
+    """The watcher's registry instruments track polls/errors/backoff next
+    to the quarantine counter — check_once on an empty dir ticks polls;
+    a failing poll sets the backoff gauge; a quiet one resets it."""
+    from ddp_classification_pytorch_tpu.serve.metrics import ServeMetrics
+    from ddp_classification_pytorch_tpu.serve.reload import CheckpointWatcher
+
+    metrics = ServeMetrics()
+    w = CheckpointWatcher(str(tmp_path), engine=None, template_state=None,
+                          poll_s=0.5, metrics=metrics)
+    assert w.poll_once() == 0.5
+    snap = metrics.registry.snapshot()
+    assert snap["watcher_polls_total"] == 1
+    assert snap["watcher_errors_total"] == 0
+    assert snap["watcher_backoff_seconds"] == 0
+
+    def boom():
+        raise OSError("fs fault")
+
+    w.check_once = boom
+    backoff = w.poll_once()
+    assert backoff == 1.0  # poll_s * 2^1
+    snap = metrics.registry.snapshot()
+    assert snap["watcher_errors_total"] == 1
+    assert snap["watcher_backoff_seconds"] == 1.0

@@ -175,11 +175,12 @@ def test_lint_script_flags_match_analyze_cli():
 
 
 def test_worklist_bench_step_captures_serve_row():
-    """The owed-work list must keep running bench with BOTH evidence rows:
-    --e2e (uint8 wire) and --serve (serve_latency) — a silently dropped
-    flag would skip the owed TPU capture without anyone noticing."""
+    """The owed-work list must keep running bench with ALL evidence rows:
+    --e2e (uint8 wire), --serve (serve_latency) and --trace (the on-device
+    step_breakdown_ms capture) — a silently dropped flag would skip the
+    owed TPU capture without anyone noticing."""
     body = _script_body("tpu_up_worklist.sh")
     bench_lines = [ln for ln in body.splitlines() if "bench.py" in ln]
     assert bench_lines, "worklist no longer runs bench.py"
-    assert any("--e2e" in ln and "--serve" in ln for ln in bench_lines), \
-        bench_lines
+    assert any("--e2e" in ln and "--serve" in ln and "--trace" in ln
+               for ln in bench_lines), bench_lines

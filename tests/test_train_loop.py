@@ -64,6 +64,17 @@ def test_baseline_records_written(tmp_path):
     tr.run()
     assert (tmp_path / "run" / "output.txt").exists()
     assert (tmp_path / "run" / "history.json").exists()
+    # the observability scrape file: host 0 rewrites $OUT/metrics.prom
+    # atomically at the log cadence and each epoch boundary — a complete
+    # Prometheus exposition with the trainer/sentinel instrument families
+    prom = (tmp_path / "run" / "metrics.prom").read_text()
+    assert "# TYPE train_steps_total counter" in prom
+    assert "# TYPE train_epochs_total counter" in prom
+    assert "train_epochs_total 1" in prom
+    assert "# TYPE sentinel_streak gauge" in prom
+    steps_line = [ln for ln in prom.splitlines()
+                  if ln.startswith("train_steps_total ")]
+    assert steps_line and float(steps_line[0].split()[1]) >= 1
 
 
 def test_arcface_e2e_smoke(tmp_path):
