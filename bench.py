@@ -766,6 +766,19 @@ def main() -> None:
                          "on-device normalization; float32 is the legacy "
                          "host-normalize wire. The row's h2d_bytes_per_step "
                          "/ input_dtype fields record what actually crossed")
+    ap.add_argument("--zero-opt", default="auto",
+                    choices=["auto", "on", "off"],
+                    help="parallel.zero_opt for the train rows: ZeRO-1 "
+                         "optimizer-state sharding over the data axis. The "
+                         "e2e row's collective_bytes_per_step/peak_hbm_bytes "
+                         "evidence records the payload/footprint difference "
+                         "('off' to A/B against the replicated-state step)")
+    ap.add_argument("--grad-reduce-dtype", default="float32",
+                    choices=["float32", "bfloat16"],
+                    help="parallel.grad_reduce_dtype for the train rows: "
+                         "bfloat16 halves the gradient-reduction wire "
+                         "payload (master params/momentum stay f32); shows "
+                         "up in the e2e row's collective_bytes_per_step")
     ap.add_argument("--serve", action="store_true",
                     help="also measure the serving path: the ServingEngine "
                          "(bounded queue → deadline batcher → bucketed "
@@ -864,6 +877,11 @@ def main() -> None:
     cfg = get_preset("baseline")
     cfg.model.arch = args.arch
     cfg.model.dtype = "bfloat16" if on_accel else "float32"
+    # ZeRO-1 / wire-dtype knobs reach every train row through cfg.parallel;
+    # the e2e row's step_comms_evidence (collective_bytes_per_step,
+    # peak_hbm_bytes) is where their effect is machine-visible
+    cfg.parallel.zero_opt = args.zero_opt
+    cfg.parallel.grad_reduce_dtype = args.grad_reduce_dtype
     cfg.data.num_classes = 1000
     # CPU caps (not pins) the image size so smoke runs can shrink further
     cfg.data.image_size = args.image_size if on_accel else min(args.image_size, 64)

@@ -183,7 +183,19 @@ def _shape_bytes(shape_str: str) -> Optional[int]:
 
 
 def _leaf_bytes(leaf) -> int:
-    return int(np.prod(leaf.shape, dtype=np.int64)) * np.dtype(leaf.dtype).itemsize
+    """Per-device bytes of one donated leaf: the LOCAL shard when the leaf
+    carries a sharding, else the global shape. XLA's alias table
+    (`alias_size_in_bytes`) is per-device, so a ZeRO-sharded momentum
+    leaf donates 1/dp of its global bytes on each device — counting the
+    global size would report coverage < 1.0 on a fully aliased step."""
+    shape = tuple(leaf.shape)
+    sh = getattr(leaf, "sharding", None)
+    if sh is not None and hasattr(sh, "shard_shape"):
+        try:
+            shape = sh.shard_shape(shape)
+        except Exception:
+            pass
+    return int(np.prod(shape, dtype=np.int64)) * np.dtype(leaf.dtype).itemsize
 
 
 def donation_evidence(jitted_fn, args: Sequence[Any],
@@ -373,22 +385,29 @@ class AuditContext:
         return jax.ShapeDtypeStruct((self.batch,), jnp.float32)
 
 
-def abstract_state(state, mesh):
+def abstract_state(state, mesh, zero_opt: str = "auto"):
     """Re-home a concrete TrainState onto `mesh` as ShapeDtypeStructs
     carrying that mesh's DECLARED shardings (params/opt under
     `parallel.mesh`'s rules — so a >1 'model' axis actually class-shards
     the head — batch_stats and step replicated, matching
-    train/state.py::create_train_state). Abstract avals are enough for
-    both `jax.make_jaxpr` and AOT `lower().compile()`, so one cached
-    state init serves every audited mesh without per-mesh init compiles."""
+    train/state.py::create_train_state). `zero_opt` follows the
+    `parallel.zero_opt` setting: the default 'auto' ZeRO-shards the big
+    optimizer leaves over 'data' whenever the mesh's data axis spans
+    devices — keep it in lockstep with the audited step's config, or the
+    compile pays resharding collectives the real trainer never sees.
+    Abstract avals are enough for both `jax.make_jaxpr` and AOT
+    `lower().compile()`, so one cached state init serves every audited
+    mesh without per-mesh init compiles."""
     from ..parallel import mesh as meshlib
 
+    zero = meshlib.zero_opt_enabled(zero_opt, mesh)
     shardings = type(state)(
         step=meshlib.replicated(mesh),
         params=meshlib.param_shardings(state.params, mesh),
         batch_stats=jax.tree_util.tree_map(
             lambda _: meshlib.replicated(mesh), state.batch_stats),
-        opt_state=meshlib.opt_shardings(state.opt_state, mesh),
+        opt_state=meshlib.opt_shardings(state.opt_state, mesh,
+                                        zero_data=zero),
     )
     return jax.tree_util.tree_map(
         lambda leaf, sh: jax.ShapeDtypeStruct(leaf.shape, leaf.dtype,
@@ -516,6 +535,23 @@ def _build_topk_predict_dp_tp(ctx: AuditContext):
     return make_topk_predict_step(cfg, model, k=3), args
 
 
+def _build_train_bf16_reduce(ctx: AuditContext):
+    """The bf16-wire gradient-reduction variant of the train step
+    (parallel.grad_reduce_dtype=bfloat16): a shard_map fwd/bwd whose
+    pmean runs at bf16 with the ZeRO-sharded optimizer update outside —
+    a different program (explicit collectives, cast pair around the
+    reduction), so it gets its own audit entry per the registry NOTE.
+    Reuses the cached baseline model/tx/state (the state layout does not
+    depend on the wire dtype)."""
+    from ..train.steps import make_train_step
+
+    _, model, tx, state = ctx.state_for("baseline")
+    cfg = ctx.tiny_cfg("baseline")
+    cfg.parallel.grad_reduce_dtype = "bfloat16"
+    fn = make_train_step(cfg, model, tx, mesh=ctx.mesh)
+    return fn, (state, ctx.images(), ctx.labels())
+
+
 def _build_shard_map_train(ctx: AuditContext):
     from ..parallel.collectives import build_ddp_model, make_shard_map_train_step
     from ..train.schedule import build_optimizer
@@ -613,6 +649,14 @@ def build_registry() -> List[StepSpec]:
             build=_build_train_survivor,
             donate=(0,),
             uint8_input=True,
+        ),
+        StepSpec(
+            name="train_step_bf16_reduce",
+            factory="ddp_classification_pytorch_tpu.train.steps:make_train_step",
+            build=_build_train_bf16_reduce,
+            donate=(0,),
+            uint8_input=True,
+            allow_collectives=True,  # the bf16 pmean IS this program
         ),
         StepSpec(
             name="shard_map_train_step",

@@ -182,6 +182,96 @@ def _spans_data(label: str) -> bool:
     return label == "all" or DATA_AXIS in label.split("+")
 
 
+# CPU XLA's reduction runtime is f32-only: a program that puts a narrower
+# dtype on the wire (parallel.grad_reduce_dtype=bfloat16) compiles as
+# convert(f32→bf16) → convert(bf16→f32) → collective(f32), the round-trip
+# pair usually folded into the kLoop fusion feeding the collective.
+# Counting the f32 shape would erase exactly the payload halving the bf16
+# reduction exists to buy (TPU ships the collective at bf16 natively), so
+# the inventory resolves each collective operand — through at most one
+# fusion — to such a round-trip and charges the op at the SOURCE dtype.
+_CONVERT_RE = re.compile(
+    r"%(?P<name>[\w.-]+)\s*=\s*(?P<dst>[a-z0-9]+)\[[\d,]*\]"
+    r"(?:\{[^}]*\})?\s*convert\((?P<src>[a-z0-9]+)\[[\d,]*\]"
+    r"(?:\{[^}]*\})?\s+%(?P<op>[\w.-]+)\)")
+_FUSION_RE = re.compile(
+    r"%(?P<name>[\w.-]+)\s*=\s*[a-z0-9]+\[[\d,]*\](?:\{[^}]*\})?\s*"
+    r"fusion\(.*\bcalls=%(?P<comp>[\w.-]+)")
+_COMP_RE = re.compile(r"^(?:ENTRY\s+)?%(?P<name>[\w.-]+)\s*\(")
+_OPERAND_RE = re.compile(r"\(([^)]*)\)")
+
+
+def _wire_dtypes(hlo_text: str) -> Dict[str, str]:
+    """Instruction name → the element type its value round-tripped
+    through right before use: widening converts whose operand is the
+    matching narrowing convert (`f32 convert(bf16 convert(f32 x))`), and
+    fusions whose called computation contains such a pair. These are
+    exactly the instructions CPU XLA materialises when promoting a
+    sub-f32 collective to its f32-only reduction runtime."""
+    converts: Dict[str, Tuple[str, str, str]] = {}
+    comp_of: Dict[str, str] = {}
+    fusions: Dict[str, str] = {}
+    comp = ""
+    for line in hlo_text.splitlines():
+        if line and line[0] not in " \t":
+            hm = _COMP_RE.match(line)
+            if hm:
+                comp = hm.group("name")
+            continue
+        if " convert(" in line:
+            cm = _CONVERT_RE.search(line)
+            if cm:
+                converts[cm.group("name")] = (
+                    cm.group("dst"), cm.group("src"), cm.group("op"))
+                comp_of[cm.group("name")] = comp
+        elif " fusion(" in line and "calls=" in line:
+            fm = _FUSION_RE.search(line)
+            if fm:
+                fusions[fm.group("name")] = fm.group("comp")
+    wire: Dict[str, str] = {}
+    comp_wire: Dict[str, str] = {}
+    for name, (dst, src, op) in converts.items():
+        inner = converts.get(op)
+        if (inner is None or src not in _DTYPE_BYTES
+                or dst not in _DTYPE_BYTES
+                or _DTYPE_BYTES[src] >= _DTYPE_BYTES[dst]
+                or inner[0] != src or inner[1] != dst):
+            continue
+        wire[name] = src
+        c = comp_of.get(name, "")
+        if comp_wire.setdefault(c, src) != src:
+            comp_wire[c] = "?"  # mixed wire dtypes: don't attribute
+    for fname, cname in fusions.items():
+        w = comp_wire.get(cname)
+        if w and w != "?":
+            wire[fname] = w
+    return wire
+
+
+def _wire_scale(operand_text: str, wire: Dict[str, str],
+                result_dtype: str) -> float:
+    """Payload scale for one collective op: when EVERY operand resolves
+    to a round-trip through one narrower dtype, the wire dtype of the
+    program is that SOURCE type and the payload scales by src/result
+    itemsize. 1.0 whenever the pattern doesn't match — unscaled is the
+    conservative (larger) count. `operand_text` starts at the
+    collective's opening paren."""
+    om = _OPERAND_RE.search(operand_text)
+    if not om or result_dtype not in _DTYPE_BYTES:
+        return 1.0
+    names = re.findall(r"%([\w.-]+)", om.group(1))
+    if not names:
+        return 1.0
+    dtypes = {wire.get(n) for n in names}
+    if len(dtypes) != 1:
+        return 1.0
+    (w,) = dtypes
+    if (w is None or w not in _DTYPE_BYTES
+            or _DTYPE_BYTES[w] >= _DTYPE_BYTES[result_dtype]):
+        return 1.0
+    return _DTYPE_BYTES[w] / _DTYPE_BYTES[result_dtype]
+
+
 def collective_inventory(hlo_text: str, mesh=None) -> Dict[str, Any]:
     """Aggregate the compiled program's collectives per kind:
     `{kinds: {kind: {count, bytes, max_op_bytes, axes: {axis: bytes}}},
@@ -189,8 +279,17 @@ def collective_inventory(hlo_text: str, mesh=None) -> Dict[str, Any]:
     (CPU XLA does not combine the per-gradient all-reduces, so counts are
     high and per-op payloads small — the BYTES are the invariant).
     Axis attribution needs `mesh`; unattributable groups land on
-    'unknown' (never silently dropped)."""
+    'unknown' (never silently dropped).
+
+    Payloads are counted at the WIRE dtype the program requested: CPU
+    XLA's reduction runtime is f32-only, so it rewrites every bf16
+    collective as convert(bf16→f32) → collective(f32) → convert back —
+    counting the f32 shape would erase exactly the payload halving a
+    bf16 gradient reduction exists to buy (TPU runs the collective at
+    bf16 natively). `_wire_scale` detects that promotion pattern and
+    scales the op back to its source dtype."""
     axis_parts = _axis_groupings(mesh) if mesh is not None else {}
+    wire = _wire_dtypes(hlo_text)
     kinds: Dict[str, Dict[str, Any]] = {}
     total = 0
     for line in hlo_text.splitlines():
@@ -198,7 +297,10 @@ def collective_inventory(hlo_text: str, mesh=None) -> Dict[str, Any]:
         if not m:
             continue
         kind = m.group("kind")
-        payload = _payload_bytes(m.group("shape"))
+        sm = _SHAPE_RE.search(m.group("shape"))
+        payload = int(round(_payload_bytes(m.group("shape"))
+                            * _wire_scale(line[m.end() - 1:], wire,
+                                          sm.group(1) if sm else "")))
         groups = parse_replica_groups(line)
         axis = "unknown"
         if groups is not None:
@@ -290,26 +392,38 @@ def sharding_table(compiled, args: Sequence[Any]) -> List[Dict[str, Any]]:
 
 
 def audit_sharding_table(rows: List[Dict[str, Any]], mesh, where: str,
-                         replicated_threshold: int = REPLICATED_BYTES
+                         replicated_threshold: int = REPLICATED_BYTES,
+                         opt_state_threshold: Optional[int] = None
                          ) -> List[Finding]:
     """The ZeRO detector: a large input buffer replicated across a >1 data
-    axis is state the data axis could shard — a silent sharding downgrade
-    once ZeRO-style sharding lands, an unclaimed HBM win until then."""
+    axis is state the data axis could shard. Now that ZeRO-1 has landed
+    (train/steps.py), the train cells run this with a TIGHT
+    `opt_state_threshold` on the optimizer-state rows (path contains
+    'opt_state'), turning "unclaimed HBM win" into an ASSERTED property:
+    any big momentum leaf left replicated across the data axis fails the
+    analyzer. Both thresholds are per-case overridable
+    (`ShardedCase.replicated_bytes` / `.opt_replicated_bytes`)."""
     from ..parallel.mesh import DATA_AXIS
 
     findings: List[Finding] = []
     if dict(mesh.shape).get(DATA_AXIS, 1) <= 1:
         return findings
     for row in rows:
-        if (row["bytes"] >= replicated_threshold
+        threshold = replicated_threshold
+        what = "ZeRO-shardable state burning HBM on every data replica"
+        if opt_state_threshold is not None and "opt_state" in row["path"]:
+            threshold = opt_state_threshold
+            what = ("optimizer state this cell asserts ZeRO-sharded "
+                    "(parallel.zero_opt) — the partition silently "
+                    "regressed to replicated")
+        if (row["bytes"] >= threshold
                 and not _uses_axis(row["_sharding"], DATA_AXIS)):
             findings.append(Finding(
                 "sharding", where,
                 f"{row['bytes']:,} B buffer `{row['path']}` "
                 f"{row['shape']} is replicated across the "
                 f"{dict(mesh.shape)[DATA_AXIS]}-way data axis "
-                f"(spec {row['spec']}) — ZeRO-shardable state burning HBM "
-                "on every data replica",
+                f"(spec {row['spec']}) — {what}",
                 {"path": row["path"], "bytes": row["bytes"],
                  "spec": row["spec"]}))
     return findings
@@ -323,18 +437,39 @@ class CommsPolicy:
 
     `allowed_kinds` beyond which any op is a finding; `small_bytes` caps
     the PER-OP payload of allowed kinds (0 = uncapped — the train step's
-    gradient all-reduces are as big as the gradients); and
+    gradient all-reduces are as big as the gradients);
     `require_grad_allreduce` asserts the dp gradient set is PRESENT
-    (data-axis all-reduce bytes ≥ the program's parameter bytes — the
-    detector for a train step that silently stopped averaging)."""
+    (data-axis gradient-reduction bytes ≥ the program's parameter bytes —
+    the detector for a train step that silently stopped averaging); and
+    `gather_bytes` (>0) caps the PER-OP all-gather payload for programs
+    where weight-sized gathers are the DESIGN (ZeRO-1's parameter
+    all-gather) — it supersedes the implicit-resharding detector with an
+    explicit ceiling: one updated-param leaf per op, never a fused
+    whole-model regather."""
 
     allowed_kinds: Tuple[str, ...]
     small_bytes: int = 0
     require_grad_allreduce: bool = False
+    gather_bytes: int = 0
 
 
 TRAIN_COMMS = CommsPolicy(allowed_kinds=("all-reduce",),
                           require_grad_allreduce=True)
+# The ZeRO-1 train step (parallel.zero_opt): the gradient exchange may
+# compile as all-reduce (CPU XLA keeps AR + per-shard slicing) or
+# reduce-scatter (TPU), and the updated param shards all-gather back —
+# per-op gathers bounded by the largest param leaf (9.4 MB conv kernel on
+# the audit config; 10 MiB ceiling), so a whole-model regather still
+# fails the cell. collective-permute is admitted because on COMPOSED
+# meshes (dp×tp) GSPMD decomposes the params-replicated-over-both-axes
+# gradient reduction into a half-payload data-axis all-reduce plus
+# neighbor permutes that complete the exchange — same bytes, split across
+# two op kinds (observed on the dp2tp2 cell).
+ZERO_TRAIN_COMMS = CommsPolicy(
+    allowed_kinds=("all-reduce", "reduce-scatter", "all-gather",
+                   "collective-permute"),
+    require_grad_allreduce=True,
+    gather_bytes=10 * 1024 * 1024)
 # eval/serve: "collective-free" up to control-sized payloads — the scalar
 # metric reductions (all-reduce) and top-k's per-shard candidate exchange
 # (all-gather, a few hundred bytes); the per-op cap is what keeps data and
@@ -345,10 +480,18 @@ EVAL_COMMS = CommsPolicy(allowed_kinds=("all-reduce", "all-gather"),
 
 
 def audit_collectives(inventory: Dict[str, Any], policy: CommsPolicy,
-                      where: str, min_grad_bytes: int = 0) -> List[Finding]:
+                      where: str, min_grad_bytes: int = 0,
+                      data_axis_size: int = 1) -> List[Finding]:
     """Inventory × policy → findings: disallowed kinds, oversized ops in
     allowed kinds, a missing gradient all-reduce set, and (independent of
-    policy) weight-sized all-gathers — the implicit-resharding detector."""
+    policy) weight-sized all-gathers — the implicit-resharding detector.
+
+    The gradient floor counts all-reduce bytes on data-spanning axes
+    PLUS reduce-scatter bytes × `data_axis_size`: a reduce-scatter's
+    result shape is 1/dp of the tensor it reduced, but it moves the same
+    gradient information — without the scale-up, the ZeRO step on a TPU
+    (where GSPMD emits genuine reduce-scatters) would trip the
+    missing-gradient detector while reducing perfectly."""
     findings: List[Finding] = []
     kinds = inventory["kinds"]
     for kind, rec in sorted(kinds.items()):
@@ -370,7 +513,17 @@ def audit_collectives(inventory: Dict[str, Any], policy: CommsPolicy,
                 "sum (device-side eval accumulation ships counts only)",
                 {"kind": kind, **{k: v for k, v in rec.items()}}))
     ag = kinds.get("all-gather")
-    if ag and ag["max_op_bytes"] >= RESHARD_BYTES:
+    if ag and policy.gather_bytes:
+        if ag["max_op_bytes"] > policy.gather_bytes:
+            findings.append(Finding(
+                "resharding", where,
+                f"all-gather of {ag['max_op_bytes']:,} B exceeds this "
+                f"program's {policy.gather_bytes:,} B per-op ceiling — "
+                "bigger than any single param leaf, i.e. XLA fused a "
+                "whole-model regather into the step instead of per-leaf "
+                "ZeRO gathers",
+                {k: v for k, v in ag.items()}))
+    elif ag and ag["max_op_bytes"] >= RESHARD_BYTES:
         findings.append(Finding(
             "resharding", where,
             f"all-gather of {ag['max_op_bytes']:,} B inside the step — "
@@ -382,11 +535,23 @@ def audit_collectives(inventory: Dict[str, Any], policy: CommsPolicy,
         got = sum(b for label, b in
                   kinds.get("all-reduce", {}).get("axes", {}).items()
                   if _spans_data(label))
+        got += data_axis_size * sum(
+            b for label, b in
+            kinds.get("reduce-scatter", {}).get("axes", {}).items()
+            if _spans_data(label))
+        if "collective-permute" in policy.allowed_kinds:
+            # On composed meshes GSPMD lowers part of the gradient
+            # exchange to collective-permutes (see ZERO_TRAIN_COMMS);
+            # permutes carry source_target_pairs, not replica_groups, so
+            # their bytes are axis-unattributable and count toward the
+            # floor only under a policy that explicitly admits the kind.
+            got += kinds.get("collective-permute", {}).get("bytes", 0)
         if got < min_grad_bytes:
             findings.append(Finding(
                 "comms", where,
-                f"all-reduces spanning the data axis carry {got:,} B/step "
-                f"but the program's parameters total {min_grad_bytes:,} B — the "
+                f"gradient reductions spanning the data axis carry "
+                f"{got:,} B/step "
+                f"but the program requires {min_grad_bytes:,} B — the "
                 "gradient all-reduce set is missing or truncated (replicas "
                 "are silently training on local gradients)",
                 {"data_axis_allreduce_bytes": got,
@@ -457,17 +622,32 @@ def step_comms_evidence(jitted_fn, args: Sequence[Any],
 
 @dataclass
 class ShardedCase:
-    """One (program, mesh) cell of the sharded audit matrix."""
+    """One (program, mesh) cell of the sharded audit matrix.
+
+    `replicated_bytes` / `opt_replicated_bytes` override the
+    `audit_sharding_table` thresholds per cell (None = module defaults):
+    the ZeRO train cells run the optimizer-state rows at 1 MiB so the
+    asserted-sharded property is non-vacuous on the tiny audit config
+    (largest momentum leaf 9.4 MB — far under the 16 MiB general
+    threshold). `min_grad_fraction` scales the gradient-reduction floor:
+    the bf16-wire cell legitimately ships HALF the f32 gradient bytes."""
 
     name: str          # registry program name
     mesh_name: str     # composed_audit_meshes key: 'dp2' | 'dp2tp2'
     build: Callable[[AuditContext, Any], Tuple[Any, Tuple[Any, ...]]]
     policy: CommsPolicy
     donate: Tuple[int, ...] = ()
+    replicated_bytes: Optional[int] = None
+    opt_replicated_bytes: Optional[int] = None
+    min_grad_fraction: float = 1.0
 
     @property
     def key(self) -> str:
         return f"{self.name}@{self.mesh_name}"
+
+
+# the ZeRO cells' asserted-property threshold for optimizer-state rows
+ZERO_OPT_REPLICATED_BYTES = 1024 * 1024
 
 
 def _case_train(ctx: AuditContext, mesh):
@@ -476,6 +656,38 @@ def _case_train(ctx: AuditContext, mesh):
     cfg, model, tx, state = ctx.state_for("baseline")
     fn = make_train_step(cfg, model, tx, mesh=mesh)
     return fn, (abstract_state(state, mesh),
+                batch_sharded(ctx.images(), mesh),
+                batch_sharded(ctx.labels(), mesh))
+
+
+def _case_train_replicated(ctx: AuditContext, mesh):
+    """The pre-ZeRO anchor: zero_opt forced off, so the committed baseline
+    keeps the replicated-optimizer program's payload/peak-HBM next to the
+    ZeRO cells — the delta IS the evidence (`--diff-baseline` fails if
+    either side drifts)."""
+    from ..train.steps import make_train_step
+
+    _, model, tx, state = ctx.state_for("baseline")
+    cfg = ctx.tiny_cfg("baseline")
+    cfg.parallel.zero_opt = "off"
+    fn = make_train_step(cfg, model, tx, mesh=mesh)
+    return fn, (abstract_state(state, mesh, zero_opt="off"),
+                batch_sharded(ctx.images(), mesh),
+                batch_sharded(ctx.labels(), mesh))
+
+
+def _case_train_bf16(ctx: AuditContext, mesh):
+    """The bf16-wire gradient reduction, zero_opt off so the cell isolates
+    ONE effect: the reduction payload halves against the replicated
+    anchor while peak HBM stays in family."""
+    from ..train.steps import make_train_step
+
+    _, model, tx, state = ctx.state_for("baseline")
+    cfg = ctx.tiny_cfg("baseline")
+    cfg.parallel.zero_opt = "off"
+    cfg.parallel.grad_reduce_dtype = "bfloat16"
+    fn = make_train_step(cfg, model, tx, mesh=mesh)
+    return fn, (abstract_state(state, mesh, zero_opt="off"),
                 batch_sharded(ctx.images(), mesh),
                 batch_sharded(ctx.labels(), mesh))
 
@@ -532,10 +744,20 @@ def sharded_registry() -> List[ShardedCase]:
         ShardedCase("eval_step", "dp2tp2", _case_eval, EVAL_COMMS),
         ShardedCase("nested_eval_step", "dp2tp2", _case_nested_eval,
                     EVAL_COMMS),
-        ShardedCase("train_step", "dp2", _case_train, TRAIN_COMMS,
-                    donate=(0,)),
-        ShardedCase("train_step", "dp2tp2", _case_train, TRAIN_COMMS,
-                    donate=(0,)),
+        # ZeRO-1 cells (parallel.zero_opt default auto=on): optimizer
+        # rows ASSERTED data-sharded at the tight threshold
+        ShardedCase("train_step", "dp2", _case_train, ZERO_TRAIN_COMMS,
+                    donate=(0,),
+                    opt_replicated_bytes=ZERO_OPT_REPLICATED_BYTES),
+        ShardedCase("train_step", "dp2tp2", _case_train, ZERO_TRAIN_COMMS,
+                    donate=(0,),
+                    opt_replicated_bytes=ZERO_OPT_REPLICATED_BYTES),
+        # the pre-ZeRO anchor and the bf16-wire variant: both banked so
+        # --diff-baseline pins the payload/HBM deltas as committed evidence
+        ShardedCase("train_step_replicated", "dp2", _case_train_replicated,
+                    TRAIN_COMMS, donate=(0,)),
+        ShardedCase("train_step_bf16", "dp2", _case_train_bf16,
+                    TRAIN_COMMS, donate=(0,), min_grad_fraction=0.5),
     ]
 
 
@@ -550,6 +772,8 @@ def audit_sharded_case(case: ShardedCase, ctx: AuditContext
                        ) -> Tuple[List[Finding], Dict[str, Any]]:
     """Compile one matrix cell and run every detector over it; returns
     (findings, the baseline record for analysis/baselines.json)."""
+    from ..parallel.mesh import DATA_AXIS
+
     mesh = ctx.composed_mesh(case.mesh_name)
     fn, args = case.build(ctx, mesh)
     ev, compiled = _compile_with_evidence(fn, args, case.donate, mesh)
@@ -557,11 +781,16 @@ def audit_sharded_case(case: ShardedCase, ctx: AuditContext
 
     findings = audit_collectives(
         ev["collectives"], case.policy, where,
-        min_grad_bytes=_param_bytes(ctx) if
-        case.policy.require_grad_allreduce else 0)
+        min_grad_bytes=int(_param_bytes(ctx) * case.min_grad_fraction) if
+        case.policy.require_grad_allreduce else 0,
+        data_axis_size=dict(mesh.shape).get(DATA_AXIS, 1))
 
     rows = sharding_table(compiled, args)
-    findings += audit_sharding_table(rows, mesh, where)
+    findings += audit_sharding_table(
+        rows, mesh, where,
+        replicated_threshold=(REPLICATED_BYTES if case.replicated_bytes
+                              is None else case.replicated_bytes),
+        opt_state_threshold=case.opt_replicated_bytes)
 
     if case.donate:
         if ev["unaliased"] or (ev["donation_coverage"] is not None

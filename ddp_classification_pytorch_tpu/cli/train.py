@@ -243,6 +243,17 @@ def build_parser() -> argparse.ArgumentParser:
                      help="arcface: partial-FC loss — class-sharded "
                           "softmax-CE over the model axis, no (B, C) "
                           "logits (needs --mp > 1, classes divisible)")
+    par.add_argument("--zero_opt", default="",
+                     choices=["", "auto", "on", "off"],
+                     help="ZeRO-1: partition optimizer state over the data "
+                          "axis (reduce-scatter grads, shard-local update, "
+                          "all-gather params); 'auto' (the default) enables "
+                          "it whenever the data axis spans >1 device")
+    par.add_argument("--grad_reduce_dtype", default="",
+                     choices=["", "float32", "bfloat16"],
+                     help="wire dtype of the cross-replica gradient "
+                          "reduction; bfloat16 halves the payload, master "
+                          "params/momentum stay f32 (torch-AMP-style)")
     par.add_argument("--multihost", action="store_true",
                      help="call jax.distributed.initialize() (TPU pods)")
 
@@ -420,6 +431,10 @@ def config_from_args(args: argparse.Namespace) -> Config:
         cfg.parallel.dcn_slices = args.dcn_slices
     if args.sharded_ce:
         cfg.parallel.arcface_sharded_ce = True
+    if args.zero_opt:
+        cfg.parallel.zero_opt = args.zero_opt
+    if args.grad_reduce_dtype:
+        cfg.parallel.grad_reduce_dtype = args.grad_reduce_dtype
     if args.moe_aux_weight is not None and args.moe_aux_weight < 0:
         raise ValueError(
             f"--moe_aux_weight must be >= 0, got {args.moe_aux_weight}")

@@ -193,6 +193,22 @@ class ParallelConfig:
     # anywhere. The scale path for 10⁵-10⁶-identity heads; requires
     # model_axis > 1 and num_classes divisible by it.
     arcface_sharded_ce: bool = False
+    # ZeRO-1 optimizer-state partitioning (Rajbhandari et al. 2020): shard
+    # each optimizer-state leaf over the data axis so XLA compiles
+    # reduce-scatter -> shard-local update -> param all-gather instead of
+    # replicated all-reduce + N identical updates. "auto" = on when the
+    # data axis spans >1 device, off otherwise; "on"/"off" force it. The
+    # update arithmetic is unchanged (each shard computes exactly the
+    # slice of the replicated update it owns), so checkpoints and parity
+    # pins are bit-compatible with the replicated layout.
+    zero_opt: str = "auto"  # auto | on | off
+    # Wire dtype for the cross-replica gradient reduction. "bfloat16"
+    # casts grads to bf16 before the reduction and back to the param
+    # dtype after, halving the all-reduce payload; the optimizer update
+    # still accumulates into f32 master params. Rides a shard_map grad
+    # section, so it composes with zero_opt but not with pipeline stages
+    # or arcface_sharded_ce (rejected at step build).
+    grad_reduce_dtype: str = "float32"  # float32 | bfloat16
 
 
 @dataclass

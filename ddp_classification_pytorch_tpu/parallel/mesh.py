@@ -259,7 +259,44 @@ def param_shardings(variables: Any, mesh: Mesh) -> Any:
     return jax.tree_util.tree_unflatten(treedef, specs)
 
 
-def opt_shardings(opt_state: Any, mesh: Mesh) -> Any:
+# ZeRO-1 (Rajbhandari et al. 2020): optimizer-state leaves below this
+# size stay replicated — slicing a 4 KiB bias momentum over 256 data
+# shards buys nothing and costs an all-gather launch per leaf.
+ZERO_MIN_BYTES = 64 * 1024
+
+
+def zero_opt_enabled(setting: str, mesh: Mesh) -> bool:
+    """Resolve a `parallel.zero_opt` setting against a mesh: 'auto' and
+    'on' both mean ZeRO iff the data axis actually spans devices (at
+    dp=1 the partition would be the identity — keep the specs clean
+    instead), 'off' disables unconditionally."""
+    if setting not in ("auto", "on", "off"):
+        raise ValueError(
+            f"parallel.zero_opt must be auto|on|off, got {setting!r}")
+    return setting != "off" and mesh.shape[DATA_AXIS] > 1
+
+
+def _zero_spec(spec: P, value: Any, data_axis_size: int) -> P:
+    """Extend a model/pipe-axis spec with a 'data' partition on the first
+    free dimension the data axis divides — the ZeRO-1 shard. Scalars and
+    small leaves (< ZERO_MIN_BYTES) keep the base spec; leaves no
+    dimension of which divides evenly stay replicated rather than pad."""
+    spec = tuple(spec)
+    if not hasattr(value, "ndim") or value.ndim == 0:
+        return P(*spec)
+    size = int(np.prod(value.shape)) * np.dtype(value.dtype).itemsize
+    if size < ZERO_MIN_BYTES:
+        return P(*spec)
+    full = list(spec) + [None] * (value.ndim - len(spec))
+    for d in range(value.ndim):
+        if full[d] is None and value.shape[d] > 0 \
+                and value.shape[d] % data_axis_size == 0:
+            full[d] = DATA_AXIS
+            return P(*full)
+    return P(*spec)
+
+
+def opt_shardings(opt_state: Any, mesh: Mesh, zero_data: bool = False) -> Any:
     """NamedSharding pytree for an optax state.
 
     jit(tx.init) does NOT propagate parameter shardings into the momentum
@@ -269,6 +306,26 @@ def opt_shardings(opt_state: Any, mesh: Mesh) -> Any:
     class-sharded momentum, everything else replicates. Without this, a
     restored state (device_put onto the template's shardings) mixes
     single-device opt leaves with mesh-wide params and jit rejects the step.
+
+    zero_data=True additionally partitions each big leaf over the 'data'
+    axis (`_zero_spec`), composing with the model/pipe rules: a
+    class-sharded momentum stays class-sharded AND gains a data split on
+    a remaining free dim. Works on concrete arrays and on avals/tracers
+    alike (only shape/dtype are read), so the step factories reuse it for
+    output sharding constraints.
     """
-    # momentum key paths embed the param key paths, so the param rules apply
-    return param_shardings(opt_state, mesh)
+    if not zero_data:
+        # momentum key paths embed the param key paths, so the param rules
+        # apply
+        return param_shardings(opt_state, mesh)
+    mp = mesh.shape[MODEL_AXIS]
+    pp = dict(mesh.shape).get(PIPE_AXIS, 1)
+    dp = mesh.shape[DATA_AXIS]
+    flat, treedef = jax.tree_util.tree_flatten_with_path(opt_state)
+    shardings = [
+        NamedSharding(mesh, _zero_spec(
+            _spec_for_param(jax.tree_util.keystr(path), value, mp, pp),
+            value, dp))
+        for path, value in flat
+    ]
+    return jax.tree_util.tree_unflatten(treedef, shardings)

@@ -501,30 +501,53 @@ class CheckpointManager:
             # clobber ckpt_best regardless of its metric
             meta = self.read_meta()
             self.best_metric = meta.get("best_metric", float("-inf"))
-            self._note_cross_world_resume(meta)
+            self._note_cross_world_resume(meta, state)
             return state, e + 1, path, self.file_digest(path)
         if os.path.exists(self.best_path):
             state = self._restore_verified(template_state, self.best_path)
             if state is not None:
                 meta = self.read_meta()
                 self.best_metric = meta.get("best_metric", float("-inf"))
-                self._note_cross_world_resume(meta)
+                self._note_cross_world_resume(meta, state)
                 return (state, int(meta.get("best_epoch", -1)) + 1,
                         self.best_path, self.file_digest(self.best_path))
         return template_state, 0, None, None
 
     @staticmethod
-    def _note_cross_world_resume(meta: dict) -> None:
+    def _note_cross_world_resume(meta: dict, state: Any = None) -> None:
         """One loud line when the restoring world differs from the one
         that wrote the checkpoint (elastic re-formation, or a deliberate
-        cross-topology resume) — the restore itself is topology-free."""
+        cross-topology resume) — the restore itself is topology-free.
+
+        With ZeRO-1 on (parallel.zero_opt), a second line records the
+        optimizer-state re-partition: save gathers every shard into the
+        FULL state (`_to_host`), so restoring into a different data-axis
+        size re-slices — each survivor gets a different 1/dp of the same
+        bytes, never a truncated or padded one."""
         saved = meta.get("world_size")
-        if saved is not None and int(saved) != jax.process_count():
+        if saved is None or int(saved) == jax.process_count():
+            return
+        host0_print(
+            f"[ckpt] cross-world resume: checkpoint written by a "
+            f"{int(saved)}-process pod, restoring into "
+            f"{jax.process_count()} (topology-free restore re-places "
+            "every leaf onto the current mesh)")
+        from ..parallel.mesh import DATA_AXIS
+
+        n = 0
+        for leaf in jax.tree_util.tree_leaves(
+                getattr(state, "opt_state", None)):
+            spec = getattr(getattr(leaf, "sharding", None), "spec", None)
+            for entry in (spec or ()):
+                names = entry if isinstance(entry, tuple) else (entry,)
+                if DATA_AXIS in [str(x) for x in names if x is not None]:
+                    n += 1
+                    break
+        if n:
             host0_print(
-                f"[ckpt] cross-world resume: checkpoint written by a "
-                f"{int(saved)}-process pod, restoring into "
-                f"{jax.process_count()} (topology-free restore re-places "
-                "every leaf onto the current mesh)")
+                f"[ckpt] ZeRO-1 optimizer state: {n} leaves re-partitioned "
+                "over the current data axis (checkpoints store the gathered "
+                "full state; world-size changes reshard, never truncate)")
 
     def restore_exact(self, template_state: Any, path: str,
                       expected_digest: str) -> Optional[Any]:

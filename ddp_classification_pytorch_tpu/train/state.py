@@ -86,9 +86,15 @@ def create_train_state(
     # jit does NOT propagate param shardings into the momentum leaves (they
     # land on one device); re-place them under the explicit rules so the
     # whole state carries NamedShardings — required for restore, where leaves
-    # are device_put onto the template's shardings (parallel/mesh.py)
+    # are device_put onto the template's shardings (parallel/mesh.py).
+    # Under ZeRO-1 (parallel.zero_opt, default auto=on when the data axis
+    # spans devices) each big momentum leaf additionally partitions over
+    # 'data' — the step's output constraints (train/steps.py) keep the
+    # layout stable, so every state buffer aliases across steps.
+    zero = meshlib.zero_opt_enabled(cfg.parallel.zero_opt, mesh)
     opt_state = jax.jit(tx.init)(params)
-    opt_state = jax.device_put(opt_state, meshlib.opt_shardings(opt_state, mesh))
+    opt_state = jax.device_put(
+        opt_state, meshlib.opt_shardings(opt_state, mesh, zero_data=zero))
 
     state = TrainState(
         step=jax.device_put(jnp.zeros((), jnp.int32), meshlib.replicated(mesh)),

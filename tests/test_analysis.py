@@ -237,6 +237,9 @@ def test_registry_names_every_step_program():
     assert names == {"train_step", "eval_step", "nested_eval_step",
                      "plc_predict", "topk_predict", "shard_map_train_step",
                      "train_step_survivor",
+                     # the bf16-wire gradient-reduction variant of the
+                     # shard_map train step (--grad_reduce_dtype bfloat16)
+                     "train_step_bf16_reduce",
                      # the same eval-family programs traced under the
                      # composed dp×tp mesh (sharded audit satellites)
                      "eval_step_dp_tp", "nested_eval_step_dp_tp",
@@ -423,15 +426,19 @@ def test_sharded_cells_audit_clean(sharded):
 
 
 def test_dp_train_step_carries_gradient_allreduce_set(sharded, audit):
-    """The acceptance invariant: under a ≥2-device data mesh the train
-    step's ONLY collective kind is all-reduce, the data-spanning payload
-    covers every parameter byte (the gradient set is present, not
-    truncated), and donation coverage stays exactly 1.0."""
+    """The acceptance invariant: under a ≥2-device data mesh the ZeRO-1
+    train step carries exactly the gradient all-reduce plus the param
+    all-gather that re-assembles the shard-local optimizer update (no
+    stray kinds), the data-spanning reduce payload covers every parameter
+    byte (the gradient set is present, not truncated), and donation
+    coverage stays exactly 1.0."""
     rec = sharded.records["train_step@dp2"]
-    assert set(rec["collectives"]) == {"all-reduce"}
+    assert set(rec["collectives"]) == {"all-reduce", "all-gather"}
     ar = rec["collectives"]["all-reduce"]
     got = sum(b for label, b in ar["axes"].items() if _spans_data(label))
     assert got >= _param_bytes(audit.ctx) > 10_000_000
+    # the ZeRO param gather is weight-sized, not a stray control gather
+    assert rec["collectives"]["all-gather"]["bytes"] > 10_000_000
     assert rec["donation_coverage"] == 1.0
 
 

@@ -174,6 +174,35 @@ def test_lint_script_flags_match_analyze_cli():
     assert "JAX_PLATFORMS=cpu" in body
 
 
+def test_zero_opt_knobs_locked_in_both_entrypoints():
+    """The ZeRO-1 / wire-dtype knobs must stay addressable from both
+    entrypoints with matching value sets: cli.train (underscore spelling,
+    feeds cfg.parallel) and bench.py (dashed spelling, feeds the e2e
+    row's collective/HBM evidence). The A/B workflow documented in
+    docs/performance.md dies silently if either side drops or renames a
+    knob — the drift failure mode this file exists to guard."""
+    from ddp_classification_pytorch_tpu.cli.train import build_parser
+
+    actions = {}
+    for action in build_parser()._actions:
+        for s in action.option_strings:
+            actions[s] = action
+    assert "--zero_opt" in actions, "cli.train lost --zero_opt"
+    assert set(actions["--zero_opt"].choices) == {"", "auto", "on", "off"}
+    assert "--grad_reduce_dtype" in actions, \
+        "cli.train lost --grad_reduce_dtype"
+    assert set(actions["--grad_reduce_dtype"].choices) == \
+        {"", "float32", "bfloat16"}
+    # bench.py is a script, not an importable module (import runs backend
+    # probes) — lock the dashed spellings and their value sets textually
+    with open(os.path.join(REPO, "bench.py")) as f:
+        src = f.read()
+    assert '"--zero-opt"' in src, "bench.py lost --zero-opt"
+    assert '"auto", "on", "off"' in src
+    assert '"--grad-reduce-dtype"' in src, "bench.py lost --grad-reduce-dtype"
+    assert '"float32", "bfloat16"' in src
+
+
 def test_worklist_bench_step_captures_serve_row():
     """The owed-work list must keep running bench with ALL evidence rows:
     --e2e (uint8 wire), --serve (serve_latency) and --trace (the on-device
