@@ -535,6 +535,29 @@ def _build_topk_predict_dp_tp(ctx: AuditContext):
     return make_topk_predict_step(cfg, model, k=3), args
 
 
+def _build_topk_predict_serve_dp(ctx: AuditContext):
+    """The dp-sharded SERVE predict (serve/engine.py on a mesh): same
+    forward as topk_predict but built with mesh= so the (B, k) outputs
+    are pinned batch-sharded over 'data' — a distinct program (explicit
+    output layout, dp-split top-k) that carries the serve-path throughput
+    claim, so it gets its own audit entry per the registry NOTE."""
+    from ..train.steps import make_topk_predict_step
+
+    mesh = ctx.composed_mesh("dp2")
+    cfg, model, _, state = ctx.state_for("baseline")
+    fn = make_topk_predict_step(cfg, model, k=3, mesh=mesh)
+    return fn, (abstract_state(state, mesh),
+                batch_sharded(ctx.images(), mesh))
+
+
+def _build_topk_predict_serve_dp_tp(ctx: AuditContext):
+    from ..train.steps import make_topk_predict_step
+
+    cfg, model, _, _ = ctx.state_for("baseline")
+    mesh, args = _dp_tp_args(ctx, "baseline", labels=False, valid=False)
+    return make_topk_predict_step(cfg, model, k=3, mesh=mesh), args
+
+
 def _build_train_bf16_reduce(ctx: AuditContext):
     """The bf16-wire gradient-reduction variant of the train step
     (parallel.grad_reduce_dtype=bfloat16): a shard_map fwd/bwd whose
@@ -619,6 +642,20 @@ def build_registry() -> List[StepSpec]:
             name="topk_predict_dp_tp",
             factory="ddp_classification_pytorch_tpu.train.steps:make_topk_predict_step",
             build=_build_topk_predict_dp_tp,
+            no_donate_reason=_EVAL_NO_DONATE,
+            uint8_input=True,
+        ),
+        StepSpec(
+            name="topk_predict_serve_dp",
+            factory="ddp_classification_pytorch_tpu.train.steps:make_topk_predict_step",
+            build=_build_topk_predict_serve_dp,
+            no_donate_reason=_EVAL_NO_DONATE,
+            uint8_input=True,
+        ),
+        StepSpec(
+            name="topk_predict_serve_dp_tp",
+            factory="ddp_classification_pytorch_tpu.train.steps:make_topk_predict_step",
+            build=_build_topk_predict_serve_dp_tp,
             no_donate_reason=_EVAL_NO_DONATE,
             uint8_input=True,
         ),

@@ -730,6 +730,19 @@ def _case_topk_predict(ctx: AuditContext, mesh):
         abstract_state(state, mesh), batch_sharded(ctx.images(), mesh))
 
 
+def _case_topk_predict_serve(ctx: AuditContext, mesh):
+    """The serve engine's dp-sharded predict (serve/engine.py on a mesh):
+    make_topk_predict_step built WITH mesh= so the (B, k) outputs are
+    pinned batch-sharded — the program every serving replica actually
+    runs, banked under the serve CommsPolicy (EVAL_COMMS: top-k candidate
+    exchanges only, control-sized)."""
+    from ..train.steps import make_topk_predict_step
+
+    cfg, model, _, state = ctx.state_for("baseline")
+    return make_topk_predict_step(cfg, model, k=3, mesh=mesh), (
+        abstract_state(state, mesh), batch_sharded(ctx.images(), mesh))
+
+
 def sharded_registry() -> List[ShardedCase]:
     """The audited (program, mesh) matrix. Train + the serve hot path
     (topk) and eval run on BOTH composed meshes; the remaining eval-family
@@ -740,6 +753,12 @@ def sharded_registry() -> List[ShardedCase]:
         ShardedCase("plc_predict", "dp2tp2", _case_plc_predict, EVAL_COMMS),
         ShardedCase("topk_predict", "dp2", _case_topk_predict, EVAL_COMMS),
         ShardedCase("topk_predict", "dp2tp2", _case_topk_predict, EVAL_COMMS),
+        # the serve engine's dp-sharded predict (output layout pinned):
+        # the program behind `--serve_devices`, proven control-plane-cheap
+        ShardedCase("topk_predict_serve_dp", "dp2",
+                    _case_topk_predict_serve, EVAL_COMMS),
+        ShardedCase("topk_predict_serve_dp_tp", "dp2tp2",
+                    _case_topk_predict_serve, EVAL_COMMS),
         ShardedCase("eval_step", "dp2", _case_eval, EVAL_COMMS),
         ShardedCase("eval_step", "dp2tp2", _case_eval, EVAL_COMMS),
         ShardedCase("nested_eval_step", "dp2tp2", _case_nested_eval,

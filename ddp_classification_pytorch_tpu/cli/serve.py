@@ -3,7 +3,7 @@ trained checkpoint (serve/engine.py; runbook: docs/serving.md).
 
     python -m ddp_classification_pytorch_tpu.cli.serve baseline \
         --model resnet50 --num_classes 2173 --watch runs/baseline \
-        --port 8000 --buckets 1,4,16 --batch_timeout_ms 5
+        --port 8000 --buckets 2,4,16 --batch_timeout_ms 5
 
 Discipline shared with `cli/train.py`:
 
@@ -66,8 +66,10 @@ def build_parser() -> argparse.ArgumentParser:
                    help="hot-reload poll cadence for --watch (default 5)")
     s.add_argument("--buckets", default="",
                    help="comma list of padded batch shapes, ascending "
-                        "(e.g. 1,4,16); compile count == bucket count. "
-                        "Default: powers of two up to --max_batch")
+                        "(e.g. 2,4,16); compile count == bucket count; every "
+                        "bucket must be divisible by the serve mesh's dp "
+                        "width (rc 2 otherwise). Default: powers of two up "
+                        "to --max_batch, rounded up to the dp width")
     s.add_argument("--max_batch", type=int, default=0,
                    help="largest micro-batch the deadline batcher assembles "
                         "(default 8)")
@@ -85,6 +87,17 @@ def build_parser() -> argparse.ArgumentParser:
     s.add_argument("--selfcheck", type=int, default=0,
                    help="serve N synthetic requests through the full engine "
                         "path, print metrics, drain, exit 0 (smoke mode)")
+    s.add_argument("--serve_devices", "--serve-devices", dest="serve_devices",
+                   type=int, default=-1,
+                   help="devices on the serve mesh's data axis (0 = all "
+                        "visible, the default): padded bucket batches shard "
+                        "over them, so throughput scales with the pod; "
+                        "buckets must divide evenly (rc 2 otherwise)")
+    s.add_argument("--aot_cache", "--aot-cache", dest="aot_cache", default="",
+                   help="AOT executable sidecar: 'auto' (default) banks "
+                        "compiled bucket programs in <ckpt dir>/aot so the "
+                        "next replica boots without compiling, 'off' "
+                        "disables, else an explicit sidecar dir")
     s.add_argument("--strict_compile", action="store_true",
                    help="make a steady-state recompile fatal (rc 2): warmup "
                         "prepays exactly len(buckets) programs and arms a "
@@ -147,7 +160,14 @@ def config_from_args(args: argparse.Namespace) -> Config:
         sv.log_every_s = args.log_every_s
     if args.strict_compile:
         sv.strict_compile = True
+    if args.serve_devices >= 0:
+        sv.serve_devices = args.serve_devices
+    if args.aot_cache:
+        sv.aot_cache = args.aot_cache
 
+    # dp divisibility re-resolves against the real mesh width in main()
+    # (inside the same rc-2 net); this catches the dp-independent errors
+    # before any backend work
     sv.resolve_buckets()  # raises ValueError on bad knob combinations
     if sv.topk > cfg.data.num_classes:
         raise ValueError(
@@ -161,6 +181,24 @@ def config_from_args(args: argparse.Namespace) -> Config:
                          "--watch <run_dir> (or --selfcheck N to smoke the "
                          "engine on fresh params)")
     return cfg
+
+
+def _resolve_aot_dir(cfg: Config) -> str:
+    """Where the AOT executable sidecar lives ("" = disabled). 'auto' puts
+    it next to the weights — the one location every replica of a
+    deployment shares — and disables itself for a weightless selfcheck
+    (fresh params have no durable identity worth keying a cache on)."""
+    mode = cfg.serve.aot_cache
+    if mode == "off":
+        return ""
+    if mode and mode != "auto":
+        return mode
+    if cfg.serve.checkpoint:
+        base = os.path.dirname(os.path.abspath(cfg.serve.checkpoint)) or "."
+        return os.path.join(base, "aot")
+    if cfg.serve.watch_dir:
+        return os.path.join(cfg.serve.watch_dir, "aot")
+    return ""
 
 
 def _install_signal_handlers(stop: threading.Event):
@@ -224,8 +262,13 @@ def main(argv: Optional[Sequence[str]] = None) -> None:
     from ..train.steps import make_topk_predict_step
     from ..utils.logging import host0_print
 
-    mesh = meshlib.make_mesh()  # serving is pure DP: all devices on 'data'
     try:
+        # serving is pure DP: --serve_devices devices (0 = all) on 'data'.
+        # Built inside the rc-2 net: an over-wide request or a dp-indivisible
+        # explicit bucket is config-shaped, not a crash
+        mesh = meshlib.serve_mesh(cfg.serve.serve_devices)
+        dp = int(mesh.shape[meshlib.DATA_AXIS])
+        cfg.serve.resolve_buckets(dp)
         model, _, state = create_train_state(cfg, mesh, steps_per_epoch=1)
         if cfg.serve.checkpoint:
             # explicit checkpoint: verification failure raises ValueError —
@@ -245,7 +288,7 @@ def main(argv: Optional[Sequence[str]] = None) -> None:
         print(f"[serve] config error: {e}", file=sys.stderr)
         raise SystemExit(2) from None
 
-    predict = make_topk_predict_step(cfg, model, cfg.serve.topk)
+    predict = make_topk_predict_step(cfg, model, cfg.serve.topk, mesh=mesh)
     metrics = ServeMetrics()
     preset = preset_for_dataset(cfg.data.dataset, cfg.data.transform)
     transform = (build_transform(preset, train=False,
@@ -253,8 +296,10 @@ def main(argv: Optional[Sequence[str]] = None) -> None:
                                  crop_size=cfg.data.train_crop_size,
                                  out_dtype=cfg.data.input_dtype)
                  if preset is not None else None)
+    aot_dir = _resolve_aot_dir(cfg)
     engine = ServingEngine.from_config(cfg, state, predict, metrics=metrics,
-                                       transform=transform)
+                                       transform=transform,
+                                       mesh=mesh, aot_dir=aot_dir)
 
     watcher = None
     if cfg.serve.watch_dir:
@@ -275,13 +320,17 @@ def main(argv: Optional[Sequence[str]] = None) -> None:
                        "until one lands)"))
 
     host0_print(f"[serve] arch={cfg.model.arch} classes={cfg.data.num_classes} "
-                f"wire={cfg.data.input_dtype} buckets="
-                f"{list(cfg.serve.resolve_buckets())} "
+                f"wire={cfg.data.input_dtype} buckets={list(engine.buckets)} "
                 f"max_batch={cfg.serve.max_batch} "
                 f"timeout={cfg.serve.batch_timeout_ms}ms "
-                f"topk={cfg.serve.topk}")
-    engine.warmup()  # compile every bucket before traffic
-    host0_print(f"[serve] warm: {len(engine.buckets)} bucket programs compiled")
+                f"topk={cfg.serve.topk} serve_devices={engine.serve_devices} "
+                f"dp={engine.dp} aot={aot_dir or 'off'}")
+    engine.warmup()  # ready every bucket executable before traffic
+    host0_print(
+        f"[serve] warm boot: {len(engine.buckets)} bucket executables "
+        "AOT-deserialized, zero compiles" if engine.aot_hit else
+        f"[serve] cold boot: {len(engine.buckets)} bucket programs compiled"
+        + (" (banked to AOT sidecar)" if aot_dir else ""))
 
     tb = None
     if cfg.run.tensorboard:

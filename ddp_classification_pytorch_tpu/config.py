@@ -303,6 +303,17 @@ class RunConfig:
     strict_compile: bool = False
 
 
+def dp_round_up_buckets(buckets: Sequence[int], dp: int) -> tuple:
+    """Round each bucket UP to the next dp multiple and dedup (ascending):
+    the compile-count bound survives data-parallel serving — at most
+    len(buckets) padded shapes, each evenly shardable over 'data'. Shared
+    by `ServeConfig.resolve_buckets` (auto-buckets) and `bench.py --serve`
+    (which must run its default bucket list on whatever mesh exists)."""
+    if dp < 1:
+        raise ValueError(f"dp must be >= 1, got {dp}")
+    return tuple(sorted({((int(b) + dp - 1) // dp) * dp for b in buckets}))
+
+
 @dataclass
 class ServeConfig:
     """Inference serving (serve/ subsystem, cli/serve.py).
@@ -322,8 +333,19 @@ class ServeConfig:
     queue_depth: int = 64  # bounded intake; submits beyond it are rejected
     # padded batch shapes (ascending). () = powers of two up to max_batch.
     # Each bucket is one compiled program; requests pad to the smallest
-    # bucket that fits the collected batch.
+    # bucket that fits the collected batch. Under a >1-device serve mesh
+    # every bucket must be divisible by the data-parallel width (each
+    # padded batch shards evenly over 'data'); auto-buckets round up.
     buckets: Sequence[int] = ()
+    # devices on the serve mesh's data axis (0 = all visible devices);
+    # per-replica throughput scales with it — the predict runs dp-sharded
+    # over the mesh, batches arrive as data-sharded global arrays
+    serve_devices: int = 0
+    # AOT executable sidecar (serve/aot.py): "auto" = <run dir>/aot next
+    # to the served checkpoint, "off" = disable, else an explicit dir. A
+    # joining replica deserializes the warmed bucket executables instead
+    # of compiling them — zero steady-state compiles on a warm boot.
+    aot_cache: str = "auto"
     topk: int = 5  # classes returned per request
     checkpoint: str = ""  # explicit checkpoint to serve (verified; rc 2 if corrupt)
     watch_dir: str = ""  # run dir to poll for checkpoint hot-reload
@@ -336,9 +358,16 @@ class ServeConfig:
     # engine stops intake and cli.serve exits rc 2 (deterministic).
     strict_compile: bool = False
 
-    def resolve_buckets(self) -> tuple:
+    def resolve_buckets(self, dp: int = 1) -> tuple:
         """Validated ascending bucket tuple (ValueError = config-shaped,
-        the serve CLI maps it to the deterministic rc 2)."""
+        the serve CLI maps it to the deterministic rc 2).
+
+        `dp` is the serve mesh's data-parallel width: every padded batch
+        shards its leading axis over 'data', so each bucket must be a
+        dp multiple or the global array cannot be assembled. Explicit
+        buckets that violate this are rejected (the operator asked for
+        shapes that cannot run); auto-buckets round UP to the next dp
+        multiple — padding overhead, never a dropped request."""
         if self.max_batch < 1:
             raise ValueError(f"serve.max_batch must be >= 1, got {self.max_batch}")
         if self.batch_timeout_ms < 0:
@@ -348,15 +377,24 @@ class ServeConfig:
             raise ValueError(f"serve.queue_depth must be >= 1, got {self.queue_depth}")
         if self.topk < 1:
             raise ValueError(f"serve.topk must be >= 1, got {self.topk}")
+        if dp < 1:
+            raise ValueError(f"serve data-parallel width must be >= 1, got {dp}")
         if self.buckets:
             buckets = tuple(int(b) for b in self.buckets)
+            bad = [b for b in buckets if b % dp]
+            if bad:
+                raise ValueError(
+                    f"serve.buckets {bad} not divisible by the serve mesh's "
+                    f"data-parallel width dp={dp} — every padded batch shards "
+                    "its leading axis over 'data', so each bucket must be a "
+                    f"multiple of {dp} (error: serve-bucket-dp-indivisible)")
         else:
             buckets, b = [], 1
             while b < self.max_batch:
                 buckets.append(b)
                 b *= 2
             buckets.append(self.max_batch)
-            buckets = tuple(sorted(set(buckets)))
+            buckets = dp_round_up_buckets(buckets, dp)
         if any(b < 1 for b in buckets) or list(buckets) != sorted(set(buckets)):
             raise ValueError(
                 f"serve.buckets must be positive and strictly ascending, "

@@ -113,6 +113,27 @@ def composed_audit_meshes(devices: Optional[Sequence[Any]] = None
     return out
 
 
+def serve_mesh(n_devices: int = 0,
+               devices: Optional[Sequence[Any]] = None) -> Mesh:
+    """Pure data-parallel mesh for the serving engine: every device on
+    'data' (the predict step has no model axis to feed — class-dim TP in
+    serving arrives via an explicitly composed mesh, not this helper).
+    `n_devices=0` takes the whole host/pod; a positive count takes a
+    deterministic prefix so replicas of different pod shapes can pin the
+    same serve width. Raises ValueError (the cli.serve rc-2 family) when
+    the request exceeds what exists."""
+    devices = list(devices) if devices is not None else jax.devices()
+    if n_devices < 0:
+        raise ValueError(f"serve_devices must be >= 0, got {n_devices}")
+    if n_devices > len(devices):
+        raise ValueError(
+            f"serve_devices={n_devices} exceeds the {len(devices)} visible "
+            "devices — lower --serve_devices or widen the deployment")
+    if n_devices:
+        devices = devices[:n_devices]
+    return make_mesh(MeshSpec(), devices=devices)
+
+
 def make_hybrid_mesh(spec: MeshSpec = MeshSpec(), *,
                      dcn_data_parallel: int = 0) -> Mesh:
     """Multi-slice mesh: data parallelism split across DCN-connected slices,

@@ -98,6 +98,8 @@ class ServingEngine:
         metrics: Optional[Any] = None,
         transform: Optional[Any] = None,
         strict_compile: bool = False,
+        mesh: Optional[Any] = None,
+        aot_dir: str = "",
     ):
         buckets = tuple(sorted(set(int(b) for b in buckets)))
         if not buckets or buckets[0] < 1:
@@ -105,6 +107,39 @@ class ServingEngine:
         if max_batch > buckets[-1]:
             raise ValueError(
                 f"max_batch={max_batch} exceeds largest bucket {buckets[-1]}")
+        # data-parallel serving: padded bucket batches are assembled as
+        # global arrays sharded over the mesh 'data' axis, so per-replica
+        # throughput scales with the pod. Every bucket must split evenly
+        # over dp — `ServeConfig.resolve_buckets(dp)` already enforces
+        # this for config-driven engines; re-checked here for direct
+        # construction (the error is load-bearing: an indivisible bucket
+        # would fail inside jit at the first unlucky batch instead).
+        self.mesh = mesh
+        if mesh is not None:
+            from ..parallel.mesh import DATA_AXIS, batch_sharding
+
+            self.dp = int(mesh.shape[DATA_AXIS])
+            self.serve_devices = int(mesh.size)
+            self._batch_sh = batch_sharding(mesh)
+            bad = [b for b in buckets if b % self.dp]
+            if bad:
+                raise ValueError(
+                    f"serve buckets {bad} not divisible by the serve mesh's "
+                    f"data-parallel width dp={self.dp} "
+                    "(error: serve-bucket-dp-indivisible)")
+        else:
+            self.dp = 1
+            self.serve_devices = 1
+            self._batch_sh = None
+        # AOT sidecar (serve/aot.py): "" disables; warmup() loads banked
+        # executables from here (warm boot, zero compiles) or banks its
+        # own after compiling (cold boot)
+        self.aot_dir = aot_dir
+        self.aot_hit = False
+        # bucket → AOT/lower-compiled executable; _run_batch dispatches
+        # through this (falling back to the plain jit for engines driven
+        # without warmup, e.g. tests poking process_once directly)
+        self._compiled: dict = {}
         self._state = state
         self._predict = predict
         self.image_size = int(image_size)
@@ -141,8 +176,16 @@ class ServingEngine:
         self.fatal_error: Optional[BaseException] = None
 
     @classmethod
-    def from_config(cls, cfg, state, predict, metrics=None, transform=None):
-        """Engine wired from a Config tree (serve + data sections)."""
+    def from_config(cls, cfg, state, predict, metrics=None, transform=None,
+                    mesh=None, aot_dir=""):
+        """Engine wired from a Config tree (serve + data sections). `mesh`
+        turns on dp-sharded serving (buckets resolve against its data-axis
+        width); `aot_dir` points at the executable sidecar."""
+        dp = 1
+        if mesh is not None:
+            from ..parallel.mesh import DATA_AXIS
+
+            dp = int(mesh.shape[DATA_AXIS])
         return cls(
             state, predict,
             image_size=cfg.data.image_size,
@@ -150,9 +193,10 @@ class ServingEngine:
             max_batch=cfg.serve.max_batch,
             batch_timeout_ms=cfg.serve.batch_timeout_ms,
             queue_depth=cfg.serve.queue_depth,
-            buckets=cfg.serve.resolve_buckets(),
+            buckets=cfg.serve.resolve_buckets(dp),
             metrics=metrics, transform=transform,
             strict_compile=cfg.serve.strict_compile,
+            mesh=mesh, aot_dir=aot_dir,
         )
 
     # -------------------------------------------------------------- intake --
@@ -225,7 +269,39 @@ class ServingEngine:
         with self._swap_lock:
             return self._generation
 
+    def state_compatible(self, new_state: Any) -> bool:
+        """Whether `new_state` can answer through the already-compiled
+        bucket executables: same pytree structure, same leaf shapes and
+        dtypes as the state serving now. The hot-reload watcher
+        (serve/reload.py) gates swaps on this — an incompatible (but
+        validly checksummed) checkpoint must be rejected at the swap
+        boundary, not explode inside a compiled program mid-batch."""
+        import jax
+
+        try:
+            cur, cur_def = jax.tree_util.tree_flatten(self._state)
+            new, new_def = jax.tree_util.tree_flatten(new_state)
+        except Exception:
+            return False
+        if cur_def != new_def or len(cur) != len(new):
+            return False
+        for c, n in zip(cur, new):
+            if (getattr(c, "shape", None) != getattr(n, "shape", None)
+                    or getattr(c, "dtype", None) != getattr(n, "dtype", None)):
+                return False
+        return True
+
     # ------------------------------------------------------------- serving --
+    def _assemble(self, batch: np.ndarray) -> Any:
+        """Padded host batch → device input: a data-sharded global array
+        on a mesh engine (the training stack's own H2D path), the numpy
+        batch unchanged on a single-device engine (jit moves it)."""
+        if self.mesh is None:
+            return batch
+        from ..parallel.mesh import make_global_array
+
+        return make_global_array(batch, self.mesh, self._batch_sh)
+
     def _bucket_for(self, n: int) -> int:
         for b in self.buckets:
             if b >= n:
@@ -267,7 +343,12 @@ class ServingEngine:
         for i, r in enumerate(reqs):
             batch[i] = r.image
         try:
-            scores, indices = self._predict(self._state, batch)
+            # warmup banks one executable per bucket (AOT-deserialized or
+            # lower+compiled); dispatching through it keeps the warm path
+            # compile-free. Engines driven without warmup fall back to the
+            # plain jit call.
+            fn = self._compiled.get(bucket, self._predict)
+            scores, indices = fn(self._state, self._assemble(batch))
             scores = np.asarray(scores)   # device sync
             indices = np.asarray(indices)
         except Exception as e:
@@ -320,39 +401,98 @@ class ServingEngine:
         return len(reqs)
 
     def warmup(self) -> None:
-        """Compile every bucket up front (zero batches, results discarded)
-        so the first real request never pays a compile — and PROVE the
-        bounded-compile claim: on a cold predict exactly `len(buckets)`
-        predict programs must compile here (a warm/shared predict may
-        compile fewer, never more). The sentinel stays armed afterwards, so
-        any steady-state compile (a shape leaking past the bucket padding)
-        is caught at the batch boundary."""
+        """Ready every bucket executable up front so the first real request
+        never pays a compile, and PROVE it with the compile sentinel:
+
+        - **warm boot** (valid AOT sidecar at `aot_dir`): deserialize the
+          banked executables and run each once — the sentinel must count
+          ZERO predict compiles, the instant-cold-start contract.
+        - **cold boot**: explicitly lower+compile each bucket (exactly
+          `len(buckets)` programs on a cold predict — a warm/shared
+          predict may dedupe to fewer, never more), then bank the
+          executables into the sidecar for the next replica.
+
+        The sentinel stays armed afterwards, so any steady-state compile
+        (a shape leaking past the bucket padding) is caught at the batch
+        boundary."""
         from ..analysis.compile_sentinel import CompileSentinel
 
-        pre = self.compiled_programs()
+        # "was this predict already warm?" — the jit dispatch cache when the
+        # runtime exposes it, else the marker a previous engine's cold
+        # warmup left on the fn (explicit lower/compile bypasses the
+        # dispatch cache, and re-lowering known avals doesn't re-log, so
+        # a shared warm predict would otherwise look like 0 compiles)
+        pre = self.compiled_programs() or \
+            getattr(self._predict, "_serve_warmed", 0)
         sentinel = CompileSentinel(tag="serve")
         sentinel.arm()
         try:
             h = self.image_size
-            for b in self.buckets:
-                scores, _ = self._predict(
-                    self._state, np.zeros((b, h, h, 3), self._np_dtype))
-                np.asarray(scores)  # block: compile belongs to warmup, not a request
-            events = sentinel.take()
+            zeros = {b: self._assemble(np.zeros((b, h, h, 3), self._np_dtype))
+                     for b in self.buckets}
             pname = getattr(self._predict, "__name__", "")
-            n_new = (len([e for e in events if e.name == pname]) if pname
-                     else len(events))
-            if pre == 0 and n_new != len(self.buckets):
-                raise RuntimeError(
-                    f"serve warmup compiled {n_new} predict programs, expected "
-                    f"exactly {len(self.buckets)} (one per bucket "
-                    f"{list(self.buckets)}) — the bucket→compile contract is "
-                    "broken (docs/serving.md)")
-            if n_new > len(self.buckets):
-                raise RuntimeError(
-                    f"serve warmup compiled {n_new} predict programs for "
-                    f"{len(self.buckets)} buckets — more shapes than the bucket "
-                    "set admits")
+
+            def count_predict(events):
+                return (len([e for e in events if e.name == pname]) if pname
+                        else len(events))
+
+            def lower_bucket(b):
+                # trace only — no compile, no sentinel event
+                return self._predict.lower(self._state, zeros[b])
+
+            loaded = None
+            if self.aot_dir:
+                from . import aot
+
+                loaded = aot.load_bucket_executables(
+                    self.aot_dir, self.mesh, self.buckets, lower_bucket)
+            if loaded is not None:
+                self._compiled = dict(loaded)
+                # the load's drift probe re-LOWERED one bucket — a trace,
+                # but jax logs its "Compiling ..." line at lowering on the
+                # sharded path, so drain those events: the zero-compile
+                # assertion below must measure pure execution of the
+                # deserialized executables
+                sentinel.take()
+                for b in self.buckets:
+                    scores, _ = self._compiled[b](self._state, zeros[b])
+                    np.asarray(scores)  # block: prove execution, not just load
+                n_new = count_predict(sentinel.take())
+                if n_new:
+                    raise RuntimeError(
+                        f"warm serve boot compiled {n_new} predict programs — "
+                        "the AOT sidecar promised zero (deserialized "
+                        "executables must not trigger compilation; "
+                        "docs/serving.md AOT runbook)")
+                self.aot_hit = True
+            else:
+                lowered = {}
+                for b in self.buckets:
+                    lowered[b] = lower_bucket(b)
+                    self._compiled[b] = lowered[b].compile()
+                    scores, _ = self._compiled[b](self._state, zeros[b])
+                    np.asarray(scores)  # compile belongs to warmup, not a request
+                n_new = count_predict(sentinel.take())
+                if pre == 0 and n_new != len(self.buckets):
+                    raise RuntimeError(
+                        f"serve warmup compiled {n_new} predict programs, expected "
+                        f"exactly {len(self.buckets)} (one per bucket "
+                        f"{list(self.buckets)}) — the bucket→compile contract is "
+                        "broken (docs/serving.md)")
+                if n_new > len(self.buckets):
+                    raise RuntimeError(
+                        f"serve warmup compiled {n_new} predict programs for "
+                        f"{len(self.buckets)} buckets — more shapes than the bucket "
+                        "set admits")
+                if self.aot_dir:
+                    from . import aot
+
+                    aot.save_bucket_executables(
+                        self.aot_dir, lowered, self._compiled, self.mesh)
+            try:
+                self._predict._serve_warmed = len(self.buckets)
+            except AttributeError:  # a predict that refuses attributes
+                pass
         except BaseException:
             # a failed warmup must not leak an armed sentinel: the module
             # refcount would keep jax's pxla logger at DEBUG (with
@@ -362,8 +502,12 @@ class ServingEngine:
         self.compile_sentinel = sentinel  # armed: steady state begins
 
     def compiled_programs(self) -> Optional[int]:
-        """jit cache size of the predict fn when the runtime exposes it —
-        the at-most-len(buckets) evidence; None when it doesn't."""
+        """How many predict programs this engine holds: the banked bucket
+        executables after warmup (the at-most-len(buckets) evidence), else
+        the predict's jit cache size when the runtime exposes it; None
+        when neither is known."""
+        if self._compiled:
+            return len(self._compiled)
         probe = getattr(self._predict, "_cache_size", None)
         try:
             return int(probe()) if callable(probe) else None

@@ -148,6 +148,19 @@ class CheckpointWatcher:
                             "(quarantined); still serving "
                             f"epoch {self.loaded_epoch}")
                 continue
+            compat = getattr(self.engine, "state_compatible", None)
+            if callable(compat) and not compat(state):
+                # valid bytes, wrong program: a checkpoint whose tree or
+                # leaf shapes no longer match the compiled bucket
+                # executables (model config drifted under the server) must
+                # be REJECTED, not quarantined — the file itself is fine,
+                # it just belongs to a different deployment
+                if self.metrics is not None:
+                    self.metrics.record_reload(ok=False)
+                host0_print(f"[serve] reload candidate epoch {e} rejected "
+                            "(state incompatible with the compiled predict); "
+                            f"still serving epoch {self.loaded_epoch}")
+                continue
             digest = self._digest_of(path)
             emit("verify_ok", epoch=e, path=path, digest=digest)
             self.engine.swap_state(state, digest=digest, generation=e)

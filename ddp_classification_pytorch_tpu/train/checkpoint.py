@@ -41,6 +41,35 @@ def _sha256_file(path: str, chunk: int = 1 << 20) -> str:
     return h.hexdigest()
 
 
+def quarantine_file(path: str, reason: str,
+                    sidecar: Optional[str] = None,
+                    kind: str = "checkpoint") -> None:
+    """Rename a corrupt/torn artifact (and its sidecar) to *.corrupt so it
+    stops matching discovery scans — the next restart must not crash on it
+    identically (that would brick --auto_resume). Kept on disk, not
+    deleted: post-mortem evidence. Shared by the checkpoint manager and
+    the serve AOT sidecar cache (serve/aot.py), which quarantines a torn
+    executable payload exactly like a torn checkpoint."""
+    dst = path + ".corrupt"
+    try:
+        os.replace(path, dst)
+    except OSError:
+        # shared-filesystem rename race: another host already moved
+        # it (FileNotFoundError) — the second rename is a no-op, the
+        # pod must end up with exactly one *.corrupt file
+        return
+    emit("quarantine", path=path, reason=reason)
+    if sidecar and os.path.exists(sidecar):
+        try:
+            os.replace(sidecar, dst + ".sha256")
+        except OSError:
+            pass
+    # `kind` keeps the chaos drill's log contract intact ("quarantined
+    # corrupt checkpoint") while letting serve/aot.py name its artifact
+    host0_print(f"[ckpt] quarantined corrupt {kind} {path} -> {dst} "
+                f"({reason})")
+
+
 def _place_like(template: Any, restored: Any) -> Any:
     """Place each restored (numpy) leaf onto the template leaf's sharding —
     COLLECTIVE-FREE by construction. `jax.device_put` onto a
@@ -220,27 +249,7 @@ class CheckpointManager:
         return _sha256_file(path)
 
     def _quarantine(self, path: str, reason: str) -> None:
-        """Rename a corrupt/torn checkpoint (and its sidecar) to *.corrupt
-        so it stops matching the epoch scan — the next restart must not
-        crash on it identically (that would brick --auto_resume). Kept on
-        disk, not deleted: post-mortem evidence."""
-        dst = path + ".corrupt"
-        try:
-            os.replace(path, dst)
-        except OSError:
-            # shared-filesystem rename race: another host already moved
-            # it (FileNotFoundError) — the second rename is a no-op, the
-            # pod must end up with exactly one *.corrupt file
-            return
-        emit("quarantine", path=path, reason=reason)
-        sidecar = self.checksum_path(path)
-        if os.path.exists(sidecar):
-            try:
-                os.replace(sidecar, dst + ".sha256")
-            except OSError:
-                pass
-        host0_print(f"[ckpt] quarantined corrupt checkpoint {path} -> {dst} "
-                    f"({reason})")
+        quarantine_file(path, reason, sidecar=self.checksum_path(path))
 
     # ----------------------------------------------------------------- save --
     def _write_many(self, state: Any, paths, prune_after: bool = False,

@@ -609,7 +609,7 @@ def make_predict_step(
 
 
 def make_topk_predict_step(
-    cfg: Config, model: Any, k: int
+    cfg: Config, model: Any, k: int, mesh: Optional[Any] = None
 ) -> Callable[[TrainState, jnp.ndarray], Tuple[jnp.ndarray, jnp.ndarray]]:
     """`(state, images) -> (probs (B, k) f32, indices (B, k) i32)` — the
     serving subsystem's predict (serve/engine.py). Same forward as
@@ -619,7 +619,14 @@ def make_topk_predict_step(
     top-k run in-jit, so the D2H fetch is k floats + k ints per request
     instead of the full class row. Eval mode has no cross-sample ops, so
     each row depends only on its own input — bucket padding (serve's
-    fixed compile shapes) cannot perturb real rows."""
+    fixed compile shapes) cannot perturb real rows.
+
+    `mesh` turns on data-parallel serving: the (B, k) outputs are pinned
+    batch-sharded over 'data' so each serve replica-shard computes and
+    keeps only its own rows — the only cross-device traffic left is
+    whatever XLA needs for the forward itself (control-sized all-gathers;
+    the audit's serve CommsPolicy fences this). Input sharding is left to
+    the caller (`make_global_array` on the padded bucket)."""
     workload = cfg.model.head
 
     def step(state: TrainState, images: jnp.ndarray):
@@ -633,6 +640,11 @@ def make_topk_predict_step(
 
     # no donation: serving reuses the state for every micro-batch (until a
     # hot-reload swap); request buffers alias nothing ((B,H,W,3) u8 → (B,k))
+    if mesh is not None:
+        from ..parallel.mesh import batch_sharding
+
+        out_sh = batch_sharding(mesh)
+        return jax.jit(step, out_shardings=(out_sh, out_sh))
     return jax.jit(step)
 
 

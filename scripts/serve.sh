@@ -8,17 +8,30 @@
 # SIGTERM drains gracefully (intake stops, queued requests answered,
 # exit 0) — safe to stop from a supervisor at any time.
 #
+# The predict runs dp-sharded over SERVE_DEVICES devices (0 = the whole
+# host/pod), and warmed bucket executables are banked in the watch dir's
+# aot/ sidecar so the next replica boots without compiling. BUCKETS
+# defaults to the CLI's auto-buckets, which round themselves up to the
+# mesh's dp width; an explicit BUCKETS list must be dp-divisible (rc 2).
+#
 # Usage: bash scripts/serve.sh <run_dir> [extra cli.serve flags...]
-# Env:   PORT (default 8000), BUCKETS (default 1,4,16), MAX_BATCH (16),
-#        BATCH_TIMEOUT_MS (5), TOPK (5)
+# Env:   PORT (default 8000), BUCKETS (default auto), MAX_BATCH (16),
+#        BATCH_TIMEOUT_MS (5), TOPK (5), SERVE_DEVICES (0 = all),
+#        AOT_CACHE (auto | off | dir)
 set -euo pipefail
 RUN_DIR=${1:?usage: bash scripts/serve.sh <run_dir> [flags...]}
+BUCKET_ARGS=()
+if [[ -n "${BUCKETS:-}" ]]; then
+  BUCKET_ARGS=(--buckets "$BUCKETS")
+fi
 python -m ddp_classification_pytorch_tpu.cli.serve baseline \
   --watch "$RUN_DIR" \
   --port "${PORT:-8000}" \
-  --buckets "${BUCKETS:-1,4,16}" \
   --max_batch "${MAX_BATCH:-16}" \
   --batch_timeout_ms "${BATCH_TIMEOUT_MS:-5}" \
   --topk "${TOPK:-5}" \
+  --serve_devices "${SERVE_DEVICES:-0}" \
+  --aot_cache "${AOT_CACHE:-auto}" \
   --out "$RUN_DIR/serve" \
+  "${BUCKET_ARGS[@]}" \
   "${@:2}"

@@ -52,6 +52,14 @@ if [ $rc -ne 0 ]; then
   esac
   exit $rc
 fi
+# the serve row must prove the AOT warm path on-chip: the bench boots one
+# cold engine (banks the aot/ sidecar) and one warm engine (deserializes
+# it), so a healthy capture has both timings and a cache hit
+grep -q '"cold_start_ms"' "$out/bench.json" \
+  || echo ">> serve row missing cold_start_ms — AOT cold/warm split not captured" >&2
+grep -q '"aot_cache_hit": true' "$out/bench.json" \
+  || echo ">> aot_cache_hit not true — warm boot recompiled instead of deserializing" >&2
+
 echo ">> if step_ms is ~48 and probe.matmul20_ms is fresh, pin" >&2
 echo ">> PROBE_UNCONTENDED_MS in bench.py to that probe value (and mirror" >&2
 echo ">> the capture into docs/performance.md — tests/test_bench_meta.py" >&2

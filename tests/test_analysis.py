@@ -243,7 +243,10 @@ def test_registry_names_every_step_program():
                      # the same eval-family programs traced under the
                      # composed dp×tp mesh (sharded audit satellites)
                      "eval_step_dp_tp", "nested_eval_step_dp_tp",
-                     "plc_predict_dp_tp", "topk_predict_dp_tp"}
+                     "plc_predict_dp_tp", "topk_predict_dp_tp",
+                     # the dp-sharded serving predict (serve mesh assembles
+                     # data-sharded global batches; docs/serving.md)
+                     "topk_predict_serve_dp", "topk_predict_serve_dp_tp"}
     for spec in build_registry():
         # every entry either donates or documents why it must not
         assert spec.donate or spec.no_donate_reason, spec.name

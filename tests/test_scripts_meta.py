@@ -213,3 +213,24 @@ def test_worklist_bench_step_captures_serve_row():
     assert bench_lines, "worklist no longer runs bench.py"
     assert any("--e2e" in ln and "--serve" in ln and "--trace" in ln
                for ln in bench_lines), bench_lines
+
+
+def test_serve_dp_aot_knobs_locked():
+    """The dp-serving / AOT-sidecar knobs must stay addressable in both
+    spellings on cli.serve (scripts use underscores, operators type
+    hyphens), and the worklist's bench step must keep verifying the warm
+    path it exists to capture (cold_start_ms banked, aot_cache_hit true)
+    — a dropped knob or needle would silently un-prove the instant
+    cold-start story on the next window."""
+    from ddp_classification_pytorch_tpu.cli.serve import build_parser
+
+    known = set()
+    for action in build_parser()._actions:
+        known.update(action.option_strings)
+    for flag in ("--serve_devices", "--serve-devices",
+                 "--aot_cache", "--aot-cache"):
+        assert flag in known, f"cli.serve lost {flag}"
+    body = _script_body("tpu_up_worklist.sh")
+    for needle in ("cold_start_ms", "aot_cache_hit"):
+        assert needle in body, \
+            f"worklist lost its {needle!r} warm-path verification"

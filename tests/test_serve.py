@@ -423,6 +423,73 @@ def test_drain_flushes_requests_queued_after_batcher_stopped(sv):
     assert all(f.result().indices.shape == (3,) for f in futures)
 
 
+# ------------------------------------------------------- dp-sharded serve --
+
+
+def test_resolve_buckets_dp_arithmetic():
+    """The dp bucket contract (docs/serving.md): explicit buckets that
+    cannot shard evenly over 'data' are a config error (the operator asked
+    for shapes that cannot run — rc 2 at the CLI), while auto-buckets
+    round UP to the next dp multiple and dedup."""
+    from ddp_classification_pytorch_tpu.config import (
+        ServeConfig,
+        dp_round_up_buckets,
+    )
+
+    assert ServeConfig(max_batch=8).resolve_buckets(2) == (2, 4, 8)
+    assert ServeConfig(max_batch=8).resolve_buckets(1) == (1, 2, 4, 8)
+    assert dp_round_up_buckets((1, 3, 4), 4) == (4,)
+    assert dp_round_up_buckets((1, 5), 4) == (4, 8)
+
+    explicit = ServeConfig(buckets=(1, 3), max_batch=3)
+    assert explicit.resolve_buckets(1) == (1, 3)
+    with pytest.raises(ValueError, match="serve-bucket-dp-indivisible"):
+        explicit.resolve_buckets(2)
+
+
+def test_engine_rejects_dp_indivisible_buckets(sv):
+    """The same fence at engine construction: a bucket the mesh cannot
+    shard must fail loudly at build time, never at assembly time inside a
+    live micro-batch."""
+    mesh = meshlib.serve_mesh(2)
+    with pytest.raises(ValueError, match="serve-bucket-dp-indivisible"):
+        ServingEngine(sv.state, sv.predict, image_size=32,
+                      input_dtype="uint8", max_batch=3, buckets=(1, 3),
+                      mesh=mesh)
+
+
+def test_dp_sharded_engine_matches_direct_predict(sv):
+    """Numerics fence for the tentpole: a dp2 engine (padded batches
+    assembled as data-sharded global arrays, dp-sharded predict) answers
+    with the SAME top-k indices as the single-device jitted predict and
+    scores equal to float tolerance — sharding adds communication, not
+    numerics."""
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    mesh = meshlib.serve_mesh(2)
+    # the module state lives on the full 8-device mesh; a serving replica
+    # holds its params replicated over ITS OWN mesh
+    state = jax.device_put(sv.state, NamedSharding(mesh, PartitionSpec()))
+    predict_dp = make_topk_predict_step(sv.cfg, sv.model, 3, mesh=mesh)
+    engine = ServingEngine(state, predict_dp, image_size=32,
+                           input_dtype="uint8", max_batch=4,
+                           batch_timeout_ms=40.0, queue_depth=16,
+                           buckets=BUCKETS, metrics=ServeMetrics(),
+                           mesh=mesh)
+    assert engine.dp == 2 and engine.serve_devices == 2
+    futures = [engine.submit(sv.imgs[i]) for i in range(4)]
+    assert engine.process_once() == 4
+    preds = [f.result(timeout=30) for f in futures]
+
+    scores, indices = sv.predict(sv.state, np.stack(sv.imgs[:4]))
+    scores, indices = np.asarray(scores), np.asarray(indices)
+    for i, p in enumerate(preds):
+        np.testing.assert_array_equal(p.indices, indices[i])
+        np.testing.assert_allclose(p.scores, scores[i], rtol=1e-5, atol=1e-6)
+    assert engine.seen_buckets == {4}
+
+
 # ------------------------------------------------------------- cli.serve --
 
 
@@ -458,9 +525,13 @@ def test_cli_serve_selfcheck_smoke(tmp_path, capsys):
     batcher thread, drains, and returns cleanly (rc 0)."""
     from ddp_classification_pytorch_tpu.cli.serve import main
 
+    # conftest forces 8 CPU devices; --serve_devices 2 keeps the explicit
+    # (2,4) buckets dp-divisible AND makes selfcheck exercise the
+    # dp-sharded predict end to end
     main(["baseline", "--model", "resnet18", "--variant", "cifar",
           "--dtype", "float32", "--num_classes", "8", "--image_size", "32",
           "--buckets", "2,4", "--max_batch", "4", "--batch_timeout_ms", "20",
+          "--serve_devices", "2",
           "--selfcheck", "5", "--platform", "cpu", "--out", str(tmp_path)])
     out = capsys.readouterr().out
     assert "selfcheck ok: 5 requests" in out

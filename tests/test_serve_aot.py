@@ -1,0 +1,165 @@
+"""AOT executable sidecar (serve/aot.py + ServingEngine.warmup).
+
+The instant-cold-start contract: a cold replica compiles its bucket
+programs once and banks the serialized executables in an aot/ sidecar;
+the NEXT replica deserializes them and boots without compiling anything
+— warmup() itself asserts zero predict compiles after a sidecar load, so
+every warm-path test here re-proves the tentpole claim. Every corruption
+mode must fall back to the cold path (serving correctness beats cold
+start speed): stale fingerprint → recompile, torn payload → quarantine
+(*.corrupt, same discipline as a torn checkpoint) + recompile, and a
+checkpoint published WITHOUT a sidecar must still hot-reload.
+
+Budget: buckets=(2,) everywhere — one compiled shape per cold engine.
+"""
+
+import os
+from types import SimpleNamespace
+
+import jax
+import numpy as np
+import pytest
+
+from ddp_classification_pytorch_tpu.config import get_preset
+from ddp_classification_pytorch_tpu.parallel import mesh as meshlib
+from ddp_classification_pytorch_tpu.serve.engine import ServingEngine
+from ddp_classification_pytorch_tpu.serve.metrics import ServeMetrics
+from ddp_classification_pytorch_tpu.serve.reload import CheckpointWatcher
+from ddp_classification_pytorch_tpu.train.checkpoint import CheckpointManager
+from ddp_classification_pytorch_tpu.train.state import create_train_state
+from ddp_classification_pytorch_tpu.train.steps import make_topk_predict_step
+
+BUCKETS = (2,)
+
+
+@pytest.fixture(scope="module")
+def sv():
+    cfg = get_preset("baseline")
+    cfg.model.arch = "resnet18"
+    cfg.model.variant = "cifar"
+    cfg.model.dtype = "float32"
+    cfg.data.num_classes = 8
+    cfg.data.image_size = 32
+    mesh = meshlib.serve_mesh(2)  # dp2 of conftest's 8 forced CPU devices
+    model, _, state = create_train_state(cfg, mesh, steps_per_epoch=1)
+    rng = np.random.default_rng(11)
+    imgs = rng.integers(0, 256, (4, 32, 32, 3)).astype(np.uint8)
+    return SimpleNamespace(cfg=cfg, mesh=mesh, model=model, state=state,
+                           imgs=imgs)
+
+
+def _engine(sv, aot_dir):
+    """Fresh predict fn per engine: a real joining replica has an empty
+    jit cache, so nothing but the sidecar may make its boot warm."""
+    predict = make_topk_predict_step(sv.cfg, sv.model, 3, mesh=sv.mesh)
+    return ServingEngine(sv.state, predict, image_size=32,
+                         input_dtype="uint8", max_batch=2,
+                         batch_timeout_ms=40.0, queue_depth=16,
+                         buckets=BUCKETS, metrics=ServeMetrics(),
+                         mesh=sv.mesh, aot_dir=aot_dir)
+
+
+def _answer(engine, img):
+    f = engine.submit(img)
+    assert engine.process_once() == 1
+    return f.result(timeout=30)
+
+
+def test_warm_boot_deserializes_zero_compile_bit_identical(sv, tmp_path):
+    """Cold boot banks the sidecar; a second engine boots warm off it —
+    warmup() asserts zero predict compiles after the load (the tentpole
+    acceptance), and warm answers are BIT-identical to cold ones."""
+    aot_dir = str(tmp_path / "aot")
+    cold = _engine(sv, aot_dir)
+    cold.warmup()
+    assert cold.aot_hit is False
+    assert sorted(os.listdir(aot_dir)) == ["aot_b2.pkl", "manifest.json"]
+    p_cold = _answer(cold, sv.imgs[0])
+
+    warm = _engine(sv, aot_dir)
+    warm.warmup()  # raises if ANY predict compile followed the load
+    assert warm.aot_hit is True
+    # the only sentinel event a warm boot may emit is the sidecar's
+    # drift-probe LOWERING of the smallest bucket (jax logs at lowering
+    # time); executing the deserialized programs emits none
+    assert warm.compile_sentinel.total <= 1
+    p_warm = _answer(warm, sv.imgs[0])
+    np.testing.assert_array_equal(p_cold.indices, p_warm.indices)
+    np.testing.assert_array_equal(p_cold.scores, p_warm.scores)  # bitwise
+
+
+def test_stale_fingerprint_falls_back_to_compile(sv, tmp_path):
+    """A sidecar from a different jax/platform/mesh must NOT load: the
+    fingerprint gate rejects it and the replica compiles normally (and
+    re-banks a fresh sidecar)."""
+    import json
+
+    aot_dir = str(tmp_path / "aot")
+    _engine(sv, aot_dir).warmup()  # bank a valid sidecar
+    manifest = os.path.join(aot_dir, "manifest.json")
+    with open(manifest) as f:
+        meta = json.load(f)
+    meta["jax_version"] = "0.0.0-stale"
+    with open(manifest, "w") as f:
+        json.dump(meta, f)
+
+    engine = _engine(sv, aot_dir)
+    engine.warmup()  # cold path: compile, then re-bank
+    assert engine.aot_hit is False
+    assert _answer(engine, sv.imgs[1]).indices.shape == (3,)
+    with open(manifest) as f:
+        assert json.load(f)["jax_version"] == jax.__version__
+
+
+def test_torn_payload_quarantined_then_compiles(sv, tmp_path):
+    """A truncated executable payload is quarantined like a torn
+    checkpoint (*.corrupt) and the boot falls back to compiling — a
+    half-written sidecar can slow a boot, never wedge or corrupt it."""
+    aot_dir = str(tmp_path / "aot")
+    _engine(sv, aot_dir).warmup()
+    payload = os.path.join(aot_dir, "aot_b2.pkl")
+    with open(payload, "r+b") as f:
+        f.truncate(32)
+
+    engine = _engine(sv, aot_dir)
+    engine.warmup()
+    assert engine.aot_hit is False
+    assert os.path.exists(payload + ".corrupt")
+    assert os.path.exists(payload)  # re-banked fresh after the fallback
+    assert _answer(engine, sv.imgs[2]).indices.shape == (3,)
+
+
+def test_hot_reload_survives_sidecar_less_publish(sv, tmp_path):
+    """A trainer publishes checkpoints, not sidecars: hot-reload onto an
+    AOT-warmed engine must swap a verified checkpoint that arrives with
+    no aot/ next to it — the warmed executables serve the new params."""
+    aot_dir = str(tmp_path / "aot")
+    run_dir = str(tmp_path / "run")
+    engine = _engine(sv, aot_dir)
+    engine.warmup()
+
+    mgr = CheckpointManager(run_dir, async_save=False)
+    state2 = sv.state.replace(params=jax.tree_util.tree_map(
+        lambda x: x * 1.5, sv.state.params))
+    mgr.save(state2, epoch=1)
+    watcher = CheckpointWatcher(run_dir, engine, sv.state)
+    assert watcher.check_once() is True
+    assert watcher.loaded_epoch == 1
+
+    got = _answer(engine, sv.imgs[0])
+    ref = np.asarray(
+        engine._predict(engine._state, np.stack([sv.imgs[0]] * 2))[0])
+    np.testing.assert_array_equal(got.scores, ref[0])
+
+
+def test_state_compatible_fences_shape_and_dtype_drift(sv):
+    """The reload gate: params with the same values-but-different tree
+    structure or leaf dtype must be rejected before a swap poisons the
+    compiled predict (which is specialized to the old avals)."""
+    engine = _engine(sv, "")
+    scaled = sv.state.replace(params=jax.tree_util.tree_map(
+        lambda x: x * 2.0, sv.state.params))
+    assert engine.state_compatible(scaled) is True
+    half = sv.state.replace(params=jax.tree_util.tree_map(
+        lambda x: np.asarray(x, np.float16), sv.state.params))
+    assert engine.state_compatible(half) is False
