@@ -22,6 +22,14 @@ Drift classes and their tolerances (DEFAULT_TOLERANCES):
   sharded digest that is now replicated (or sharded differently) changed
   the program's layout contract.
 - **donation regression** — zero tolerance below the baseline's coverage.
+- **wire-dtype drift** — zero tolerance: a collective kind carrying a
+  sub-f32 element type the baseline did not record is an unreviewed
+  precision cut on the wire (the live `dtype-wire` contract catches the
+  undeclared case; this fence also pins the DECLARED cells' op counts).
+
+The same file carries the numerics pass's per-cell summaries under
+`dtype_programs` (see `dtype_audit.diff_dtype_baseline` for its drift
+classes) — one committed artifact, one `--update-baseline` runbook.
 
 Shrinkage (fewer bytes, lower peak) is NOT a finding — it is the
 improvement the fence exists to protect; regenerate the baseline to bank
@@ -58,14 +66,23 @@ def load_baseline(path: Optional[str] = None) -> Dict[str, Any]:
 
 
 def write_baseline(records: Dict[str, Any], path: Optional[str] = None,
-                   context: Optional[Dict[str, Any]] = None) -> str:
+                   context: Optional[Dict[str, Any]] = None,
+                   dtype_records: Optional[Dict[str, Any]] = None) -> str:
     """Persist audit records with a provenance header (tool, jax version,
     platform/device count, audit config, regeneration runbook pointer).
     Deterministic layout (sorted keys, stable indent) so the committed
-    diff shows exactly the drifted fields."""
+    diff shows exactly the drifted fields. `dtype_records` (the numerics
+    pass's per-cell summaries) land under `dtype_programs` so one
+    --update-baseline invocation regenerates both sections; when None the
+    previously banked section is carried forward unchanged."""
     import jax
 
+    from .dtype_audit import DTYPE_TOLERANCES
+
     path = path or DEFAULT_BASELINE_PATH
+    if dtype_records is None and os.path.exists(path):
+        with open(path) as f:
+            dtype_records = json.load(f).get("dtype_programs")
     payload = {
         "_provenance": {
             "generated_by": "python -m ddp_classification_pytorch_tpu."
@@ -80,8 +97,9 @@ def write_baseline(records: Dict[str, Any], path: Optional[str] = None,
                     "review the diff as part of the PR — see "
                     "docs/analysis.md '--update-baseline runbook'.",
         },
-        "tolerances": dict(DEFAULT_TOLERANCES),
+        "tolerances": {**DEFAULT_TOLERANCES, **DTYPE_TOLERANCES},
         "programs": records,
+        "dtype_programs": dtype_records or {},
     }
     with open(path, "w") as f:
         json.dump(payload, f, indent=2, sort_keys=True)
@@ -129,6 +147,18 @@ def diff_baseline(records: Dict[str, Any], baseline: Dict[str, Any],
                 "cross-device traffic the step did not have when the "
                 "baseline was banked",
                 {"new_kinds": new_kinds}))
+
+        _sub_f32 = {"bf16", "f16", "f8e4m3fn", "f8e5m2"}
+        for kind, dtypes in sorted(rec.get("wire_dtypes", {}).items()):
+            base_dts = base.get("wire_dtypes", {}).get(kind, {})
+            new_narrow = sorted(set(dtypes) & _sub_f32 - set(base_dts))
+            if new_narrow:
+                findings.append(Finding(
+                    "baseline", key,
+                    f"`{kind}` now carries sub-f32 wire dtype(s) "
+                    f"{new_narrow} the baseline did not record — an "
+                    "unreviewed precision cut on the wire",
+                    {"kind": kind, "new": new_narrow}))
 
         cur_b = rec.get("collective_bytes_per_step", 0) or 0
         base_b = base.get("collective_bytes_per_step", 0) or 0

@@ -601,7 +601,18 @@ def _build_shard_map_train(ctx: AuditContext):
 
 def build_registry() -> List[StepSpec]:
     """Every jitted step program the framework runs, with its invariants.
-    Ordered cheap-to-expensive so a red CLI run fails fast."""
+    Ordered cheap-to-expensive so a red CLI run fails fast.
+
+    NOTE: a new jitted step factory MUST be registered here — it is then
+    donation/epilogue/callback-audited automatically, AND wrapped into the
+    dtype pass's contract cells by `dtype_audit.dtype_registry()` (D1–D6
+    at the f32-pinned audit precision; name-prefix `train_step`/
+    `shard_map_train` turns on the D2 master-weights contract). A NEW
+    PRECISION KNOB additionally needs an explicit `#<knob>` cell (plus a
+    `WAIVER_REASONS` entry if it trades precision) in `dtype_registry()`.
+    The `lint_jit_sites` guard (tests/conftest.py) fails on any
+    `jax.jit` site in train/steps.py that is not reachable from a
+    registered factory."""
     return [
         StepSpec(
             name="plc_predict",

@@ -1,6 +1,6 @@
 """AST lint: host-sync idioms in step factories, rc-catalogue discipline.
 
-Two source-level passes complementing the program-level jaxpr audit:
+Three source-level passes complementing the program-level jaxpr audit:
 
 1. **host-sync** — the functions registered in `jaxpr_audit.build_registry`
    (each `StepSpec.factory`) build the jitted hot path; any host-sync idiom
@@ -18,7 +18,13 @@ Two source-level passes complementing the program-level jaxpr audit:
    declared `exit_code`/`code` attribute (SentinelDiverged.exit_code,
    PodAbort.code, …) — the pattern the CLIs use for class-carried codes.
 
-Both passes expose `*_source` variants that lint a source string, so the
+3. **jit-registration** — every `jax.jit` site in `train/steps.py` must
+   live inside a factory registered in `jaxpr_audit.build_registry` (or a
+   documented delegate/exempt helper): an unregistered jit site is a hot
+   program the donation/collective/dtype audits silently never see — the
+   registry NOTE's discipline, enforced instead of trusted.
+
+All passes expose `*_source` variants that lint a source string, so the
 test fixtures can prove each detector trips on a known-bad sample without
 planting bad files in the package.
 """
@@ -134,6 +140,70 @@ def lint_step_factories(factories: Optional[Iterable[str]] = None
     return findings
 
 
+# ------------------------------------------------------- jit registration --
+
+# helpers the registered factories delegate their jit calls to (the shared
+# step skeleton and the sharded-eval builder make_eval_step dispatches to)
+_JIT_DELEGATES = frozenset({"_build_step", "_make_arcface_sharded_eval"})
+
+# jit sites deliberately OUTSIDE the registry, each with the reviewed why
+_JIT_EXEMPT = {
+    "make_phase_probes":
+        "bench-only fwd/bwd timing probes over the SAME production loss "
+        "(obs breakdown attribution) — never a production hot path; the "
+        "production program they time IS registered",
+}
+
+
+def lint_jit_source(src: str, registered: Iterable[str],
+                    path: str = "<fixture>") -> List[Finding]:
+    """jit-registration lint over one source string: every `jax.jit(...)`
+    call must sit inside a function in `registered` ∪ delegates ∪ exempt
+    (module-level jit sites are never allowed) — the fixture-facing
+    surface."""
+    allowed = set(registered) | _JIT_DELEGATES | set(_JIT_EXEMPT)
+    findings: List[Finding] = []
+    tree = ast.parse(src)
+    enclosing: dict = {}
+    for top in tree.body:
+        if isinstance(top, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for node in ast.walk(top):
+                enclosing[id(node)] = top.name
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        name, recv = _called_name(node)
+        if not (name == "jit" and recv in (None, "jax")):
+            continue
+        owner = enclosing.get(id(node))
+        if owner is None or owner not in allowed:
+            where = f"{path}:{node.lineno}"
+            findings.append(Finding(
+                "jit-registration", where,
+                f"`jax.jit` site in `{owner or '<module level>'}` is not "
+                "reachable from a registered step factory — register the "
+                "factory in jaxpr_audit.build_registry() (the donation/"
+                "collective/dtype audits key off it) or document it in "
+                "lint._JIT_EXEMPT",
+                {"function": owner}))
+    return findings
+
+
+def lint_jit_sites() -> List[Finding]:
+    """jit-registration lint over `train/steps.py`: registered names are
+    the registry factories' top-level functions in that module."""
+    from .jaxpr_audit import build_registry
+
+    module = "ddp_classification_pytorch_tpu.train.steps"
+    registered = {s.factory.split(":")[1] for s in build_registry()
+                  if s.factory.startswith(module + ":")}
+    mod = importlib.import_module(module)
+    path = inspect.getsourcefile(mod) or module
+    with open(path) as f:
+        src = f.read()
+    return lint_jit_source(src, registered, os.path.basename(path))
+
+
 # ----------------------------------------------------------- rc catalogue --
 
 def _exit_code_findings(call_args: Sequence[ast.expr], where: str,
@@ -201,5 +271,5 @@ def lint_rc_sites(paths: Optional[Sequence[str]] = None) -> List[Finding]:
 
 
 def run_lint() -> List[Finding]:
-    """Both source passes — the `--passes lint` entry point."""
-    return lint_step_factories() + lint_rc_sites()
+    """All source passes — the `--passes lint` entry point."""
+    return lint_step_factories() + lint_jit_sites() + lint_rc_sites()

@@ -272,6 +272,62 @@ def _wire_scale(operand_text: str, wire: Dict[str, str],
     return _DTYPE_BYTES[w] / _DTYPE_BYTES[result_dtype]
 
 
+_SUB_F32_WIRE = frozenset({"bf16", "f16", "f8e4m3fn", "f8e5m2"})
+
+
+def collective_wire_dtypes(hlo_text: str) -> Dict[str, Dict[str, int]]:
+    """Per collective kind, op counts by WIRE dtype: `{kind: {dtype: n}}`.
+    The wire dtype is the op's own element type, except when every operand
+    resolves through `_wire_dtypes`' promotion round-trip — then it is the
+    SOURCE type the program requested (CPU XLA's f32-only reduction
+    runtime materialises bf16 collectives as convert pairs; TPU runs them
+    natively). This is the `dtype-wire` contract's HLO-tier input — the
+    same accounting `_wire_scale` uses for payload bytes, promoted from
+    byte-scaling evidence to a per-cell dtype table."""
+    wire = _wire_dtypes(hlo_text)
+    out: Dict[str, Dict[str, int]] = {}
+    for line in hlo_text.splitlines():
+        m = _OP_RE.search(line)
+        if not m:
+            continue
+        sm = _SHAPE_RE.search(m.group("shape"))
+        dtype = sm.group(1) if sm else "?"
+        om = _OPERAND_RE.search(line[m.end() - 1:])
+        names = re.findall(r"%([\w.-]+)", om.group(1)) if om else []
+        resolved = {wire.get(n) for n in names}
+        if names and len(resolved) == 1:
+            (w,) = resolved
+            if (w is not None and w in _DTYPE_BYTES
+                    and dtype in _DTYPE_BYTES
+                    and _DTYPE_BYTES[w] < _DTYPE_BYTES[dtype]):
+                dtype = w
+        rec = out.setdefault(m.group("kind"), {})
+        rec[dtype] = rec.get(dtype, 0) + 1
+    return out
+
+
+def audit_wire_dtypes(wire_table: Dict[str, Dict[str, int]],
+                      declared: str, where: str) -> List[Finding]:
+    """D5 at the compiled tier: every sub-f32 collective wire dtype must be
+    DECLARED by the cell (`ShardedCase.wire_dtype`). The only shipped
+    declaration is the `grad_reduce_dtype=bfloat16` round-trip; an
+    undeclared narrow collective is an unreviewed precision cut on the
+    gradient (or worse, activation) wire."""
+    findings: List[Finding] = []
+    for kind, dtypes in sorted(wire_table.items()):
+        for dtype, count in sorted(dtypes.items()):
+            if dtype in _SUB_F32_WIRE and dtype != declared:
+                findings.append(Finding(
+                    "dtype-wire", where,
+                    f"{count} `{kind}` op(s) put {dtype} on the wire but "
+                    f"the cell declares wire_dtype={declared} — the only "
+                    "admitted sub-f32 collective is the declared "
+                    "grad_reduce_dtype round-trip",
+                    {"kind": kind, "dtype": dtype, "count": count,
+                     "declared": declared}))
+    return findings
+
+
 def collective_inventory(hlo_text: str, mesh=None) -> Dict[str, Any]:
     """Aggregate the compiled program's collectives per kind:
     `{kinds: {kind: {count, bytes, max_op_bytes, axes: {axis: bytes}}},
@@ -630,7 +686,10 @@ class ShardedCase:
     asserted-sharded property is non-vacuous on the tiny audit config
     (largest momentum leaf 9.4 MB — far under the 16 MiB general
     threshold). `min_grad_fraction` scales the gradient-reduction floor:
-    the bf16-wire cell legitimately ships HALF the f32 gradient bytes."""
+    the bf16-wire cell legitimately ships HALF the f32 gradient bytes.
+    `wire_dtype` is the narrowest collective element type the cell
+    DECLARES ('bf16' only on the grad_reduce_dtype=bfloat16 cell): any
+    sub-f32 wire dtype beyond it is a `dtype-wire` finding (D5)."""
 
     name: str          # registry program name
     mesh_name: str     # composed_audit_meshes key: 'dp2' | 'dp2tp2'
@@ -640,6 +699,7 @@ class ShardedCase:
     replicated_bytes: Optional[int] = None
     opt_replicated_bytes: Optional[int] = None
     min_grad_fraction: float = 1.0
+    wire_dtype: str = "f32"
 
     @property
     def key(self) -> str:
@@ -776,7 +836,8 @@ def sharded_registry() -> List[ShardedCase]:
         ShardedCase("train_step_replicated", "dp2", _case_train_replicated,
                     TRAIN_COMMS, donate=(0,)),
         ShardedCase("train_step_bf16", "dp2", _case_train_bf16,
-                    TRAIN_COMMS, donate=(0,), min_grad_fraction=0.5),
+                    TRAIN_COMMS, donate=(0,), min_grad_fraction=0.5,
+                    wire_dtype="bf16"),
     ]
 
 
@@ -803,6 +864,11 @@ def audit_sharded_case(case: ShardedCase, ctx: AuditContext
         min_grad_bytes=int(_param_bytes(ctx) * case.min_grad_fraction) if
         case.policy.require_grad_allreduce else 0,
         data_axis_size=dict(mesh.shape).get(DATA_AXIS, 1))
+
+    # D5 at the compiled tier: the cell's collective wire-dtype table is a
+    # CONTRACT (and a banked baseline key), not just payload accounting
+    wire_table = collective_wire_dtypes(compiled.as_text())
+    findings += audit_wire_dtypes(wire_table, case.wire_dtype, where)
 
     rows = sharding_table(compiled, args)
     findings += audit_sharding_table(
@@ -833,6 +899,8 @@ def audit_sharded_case(case: ShardedCase, ctx: AuditContext
                    "axes": dict(sorted(rec["axes"].items()))}
             for kind, rec in sorted(ev["collectives"]["kinds"].items())},
         "collective_bytes_per_step": ev["collective_bytes_per_step"],
+        "wire_dtypes": {k: dict(sorted(v.items()))
+                        for k, v in sorted(wire_table.items())},
         "peak_hbm_bytes": ev["peak_hbm_bytes"],
         "temp_bytes": ev["memory"]["temp_bytes"],
         "arg_bytes": ev["memory"]["arg_bytes"],

@@ -10,9 +10,9 @@ Exit discipline (same taxonomy as cli.train / cli.serve, docs/operations.md):
 
 - **rc 0** — every invariant holds (donation aliasing, callback-free hot
   paths, uint8 epilogue, collective-free eval/serve programs, host-sync-free
-  step factories, catalogued CLI exit codes, sharding/comms policies, and —
-  under `--diff-baseline` — no drift beyond the committed baseline's
-  tolerances);
+  step factories, catalogued CLI exit codes, sharding/comms policies, the
+  dtype pass's numerics contracts D1–D6, and — under `--diff-baseline` —
+  no drift beyond the committed baseline's tolerances);
 - **rc 1** — findings: each printed as `[check] where: message`, machine
   copies via `--json`;
 - **rc 2** — usage/config error (unknown pass name, argparse errors, a
@@ -36,7 +36,7 @@ import os
 import sys
 from typing import Optional, Sequence
 
-PASSES = ("jaxpr", "lint", "sharding")
+PASSES = ("jaxpr", "lint", "sharding", "dtype")
 
 # the composed audit meshes (dp2, dp2tp2) need ≥4 devices; on CPU we force
 # a virtual topology BEFORE backend init so baselines are host-independent
@@ -53,8 +53,11 @@ def build_parser() -> argparse.ArgumentParser:
                    help="comma list of passes to run: jaxpr (trace/compile "
                         "the step registry), lint (AST passes), sharding "
                         "(compile the program×mesh matrix: collective "
-                        "inventory, sharding table, memory budget); "
+                        "inventory, sharding table, memory budget), dtype "
+                        "(numerics contracts D1-D6 over every cell); "
                         "default: all")
+    p.add_argument("--dtype", action="store_true",
+                   help="shorthand: add the dtype pass to --passes")
     p.add_argument("--arch", default="resnet18",
                    help="backbone for the audit's tiny traced config "
                         "(invariants are program-structure properties, "
@@ -101,10 +104,13 @@ def main(argv: Optional[Sequence[str]] = None) -> None:
         print(f"[analyze] config error: unknown pass(es) {unknown or passes}; "
               f"choose from {list(PASSES)}", file=sys.stderr)
         raise SystemExit(2)
-    if (args.diff_baseline or args.update_baseline) and "sharding" not in passes:
-        passes = passes + ("sharding",)  # the baseline IS the sharding pass
+    if args.dtype and "dtype" not in passes:
+        passes = passes + ("dtype",)
+    if args.diff_baseline or args.update_baseline:
+        # the baseline file is the sharding + dtype passes' joint artifact
+        passes += tuple(p for p in ("sharding", "dtype") if p not in passes)
 
-    if ("jaxpr" in passes or "sharding" in passes) and (
+    if ("jaxpr" in passes or "sharding" in passes or "dtype" in passes) and (
             args.platform or "cpu") == "cpu":
         # the registry's dp×tp entries and the sharded matrix need the
         # composed 2×1/2×2 meshes: force a virtual multi-device CPU
@@ -137,6 +143,7 @@ def main(argv: Optional[Sequence[str]] = None) -> None:
             print(f"  {spec.name:22s} {spec.factory}")
             print(f"  {'':22s} invariants: {', '.join(props)}")
         print("lint pass: host-sync idioms in the factories above; "
+              "jit-registration guard over train/steps.py; "
               "rc catalogue over cli/ exits (docs/operations.md matrix)")
         from ..analysis.sharding_audit import sharded_registry
 
@@ -146,20 +153,32 @@ def main(argv: Optional[Sequence[str]] = None) -> None:
                   f"allowed={list(case.policy.allowed_kinds)}"
                   + (" + gradient all-reduce floor"
                      if case.policy.require_grad_allreduce else
-                     f", per-op ≤ {case.policy.small_bytes}B"))
+                     f", per-op ≤ {case.policy.small_bytes}B")
+                  + f", wire≥{case.wire_dtype}")
+        from ..analysis.dtype_audit import dtype_registry
+
+        print("dtype pass (program × precision-config cells, contracts "
+              "D1-D6):")
+        for dcase in dtype_registry():
+            waived = ",".join(sorted(dcase.waivers)) or "none"
+            print(f"  {dcase.name:34s} "
+                  f"{'train (D2 master-weights)' if dcase.train else 'eval'}"
+                  f", waivers: {waived}")
         return
 
     findings = []
     evidence = {}
 
     if "lint" in passes:
-        from ..analysis.lint import lint_rc_sites, lint_step_factories
+        from ..analysis.lint import (lint_jit_sites, lint_rc_sites,
+                                     lint_step_factories)
 
         findings += lint_step_factories()
+        findings += lint_jit_sites()
         findings += lint_rc_sites(paths=args.rc_paths)
 
     ctx = None
-    if "jaxpr" in passes or "sharding" in passes:
+    if "jaxpr" in passes or "sharding" in passes or "dtype" in passes:
         import jax
 
         # analysis is host-side program inspection: pin CPU so a wedged TPU
@@ -169,9 +188,10 @@ def main(argv: Optional[Sequence[str]] = None) -> None:
 
         ctx = AuditContext(arch=args.arch, image_size=args.image_size,
                            num_classes=args.num_classes, batch=args.batchsize)
-        if "sharding" in passes and jax.device_count() < 4:
-            print(f"[analyze] config error: the sharding pass needs ≥4 "
-                  f"devices for the composed audit meshes, have "
+        if ("sharding" in passes or "dtype" in passes) \
+                and jax.device_count() < 4:
+            print(f"[analyze] config error: the sharding/dtype passes need "
+                  f"≥4 devices for the composed audit meshes, have "
                   f"{jax.device_count()} (force more via XLA_FLAGS="
                   "--xla_force_host_platform_device_count=8)",
                   file=sys.stderr)
@@ -190,8 +210,8 @@ def main(argv: Optional[Sequence[str]] = None) -> None:
                       f"aliased={don['aliased_bytes']}B "
                       f"coverage={don['donation_coverage']}")
 
+    records = None
     if "sharding" in passes:
-        from ..analysis import baseline as baselib
         from ..analysis.sharding_audit import audit_sharded_registry
 
         sh_findings, records = audit_sharded_registry(ctx)
@@ -204,21 +224,44 @@ def main(argv: Optional[Sequence[str]] = None) -> None:
                   f"peak_hbm={rec['peak_hbm_bytes']}B"
                   + (f" coverage={rec['donation_coverage']}"
                      if rec["donation_coverage"] is not None else ""))
-        if args.update_baseline:
-            path = baselib.write_baseline(
-                records, args.baseline or None,
-                context={"arch": args.arch, "image_size": args.image_size,
-                         "num_classes": args.num_classes,
-                         "batch": args.batchsize})
-            print(f"[analyze] baseline written: {path} "
-                  f"({len(records)} programs) — review + commit the diff")
-        elif args.diff_baseline:
-            try:
-                base = baselib.load_baseline(args.baseline or None)
-            except FileNotFoundError as e:
-                print(f"[analyze] config error: {e}", file=sys.stderr)
-                raise SystemExit(2)
-            findings += baselib.diff_baseline(records, base)
+
+    dtype_records = None
+    if "dtype" in passes:
+        from ..analysis.dtype_audit import audit_dtype_registry
+
+        dt_findings, dtype_records = audit_dtype_registry(ctx)
+        findings += dt_findings
+        evidence["dtype"] = dtype_records
+        for key, rec in dtype_records.items():
+            print(f"[analyze] {key}: bf16_ops={rec['bf16_op_fraction']} "
+                  f"casts={sum(rec['casts'].values())} "
+                  f"wire={'+'.join(rec['collective_dtypes']) or 'none'} "
+                  f"waivers={','.join(rec['waivers']) or 'none'}")
+
+    if args.update_baseline:
+        from ..analysis import baseline as baselib
+
+        path = baselib.write_baseline(
+            records or {}, args.baseline or None,
+            context={"arch": args.arch, "image_size": args.image_size,
+                     "num_classes": args.num_classes,
+                     "batch": args.batchsize},
+            dtype_records=dtype_records)
+        print(f"[analyze] baseline written: {path} "
+              f"({len(records or {})} sharded + "
+              f"{len(dtype_records or {})} dtype cells) — review + commit "
+              "the diff")
+    elif args.diff_baseline:
+        from ..analysis import baseline as baselib
+        from ..analysis.dtype_audit import diff_dtype_baseline
+
+        try:
+            base = baselib.load_baseline(args.baseline or None)
+        except FileNotFoundError as e:
+            print(f"[analyze] config error: {e}", file=sys.stderr)
+            raise SystemExit(2)
+        findings += baselib.diff_baseline(records or {}, base)
+        findings += diff_dtype_baseline(dtype_records or {}, base)
 
     if args.json:
         with open(args.json, "w") as f:

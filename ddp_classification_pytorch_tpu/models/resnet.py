@@ -162,8 +162,10 @@ class ResNet(nn.Module):
                     name=f"layer{i + 1}_block{j}",
                 )(x)
 
-        x = jnp.mean(x, axis=(1, 2))  # global average pool (adaptive, any input size)
-        x = x.astype(jnp.float32)
+        # global average pool (adaptive, any input size); f32 output — the
+        # pool feeds the f32 head, so rounding the mean back to the compute
+        # dtype would only discard mantissa bits in between (dtype audit D6)
+        x = jnp.mean(x, axis=(1, 2), dtype=jnp.float32)
         if self.num_classes > 0:
             x = nn.Dense(self.num_classes, dtype=jnp.float32, name="fc")(x)
         return x

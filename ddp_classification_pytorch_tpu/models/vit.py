@@ -212,10 +212,14 @@ class ViT(nn.Module):
                           self.moe_experts, self.moe_top_k, self.moe_axis,
                           self.flash_min_tokens, self.ln_bf16,
                           name=f"block{i}")(x, train)
-        x = nn.LayerNorm(dtype=self.dtype if self.ln_bf16 else jnp.float32,
-                         name="ln_final")(x)
-        x = x.mean(axis=1)  # token mean-pool; shard-friendly (see module doc)
-        x = x.astype(jnp.float32)
+        # ln_final stays f32 even under --ln_bf16: its output feeds only the
+        # f32 pool/head, so a bf16 affine here buys no matmul throughput and
+        # just rounds the logits' inputs (dtype audit D6)
+        x = nn.LayerNorm(dtype=jnp.float32, name="ln_final")(x)
+        # token mean-pool; shard-friendly (see module doc). f32 output: the
+        # pool feeds the f32 head, so rounding the mean back to the compute
+        # dtype would only discard mantissa bits in between (dtype audit D6)
+        x = x.mean(axis=1, dtype=jnp.float32)
         if self.num_classes > 0:
             x = nn.Dense(self.num_classes, dtype=jnp.float32, name="fc")(x)
         return x

@@ -1,9 +1,10 @@
 #!/usr/bin/env bash
 # Program-invariant analyzer over the repo itself — the CI gate.
 #
-# Runs every pass of cli.analyze (jaxpr/HLO donation audit, host-sync and
-# rc-catalogue lint, sharding/comms audit of the program × composed-mesh
-# matrix) on CPU and diffs the sharded records against the committed
+# Runs every pass of cli.analyze (jaxpr/HLO donation audit, host-sync /
+# jit-registration / rc-catalogue lint, sharding/comms audit of the
+# program × composed-mesh matrix, dtype numerics contracts D1-D6) on CPU
+# and diffs the sharded + dtype records against the committed
 # analysis/baselines.json, exiting with its code: 0 clean, 1 findings
 # (each printed as `[check] where: message`; runbook docs/analysis.md),
 # 2 usage error. The analyzer self-forces a multi-device CPU topology, so
@@ -24,4 +25,4 @@ set -euo pipefail
 cd "$(dirname "$0")/.."
 
 JAX_PLATFORMS=cpu exec python -m ddp_classification_pytorch_tpu.cli.analyze \
-    --passes jaxpr,lint,sharding --diff-baseline "$@"
+    --passes jaxpr,lint,sharding,dtype --diff-baseline "$@"

@@ -22,3 +22,22 @@ import jax  # noqa: E402  (already imported by sitecustomize; harmless)
 
 jax.config.update("jax_platforms", "cpu")
 jax.config.update("jax_threefry_partitionable", True)
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session", autouse=True)
+def _jit_registration_guard():
+    """Every `jax.jit` site in train/steps.py must be reachable from a
+    factory registered in jaxpr_audit.build_registry (or a documented
+    delegate/exempt): an unregistered jit site is a hot program the
+    donation/collective/dtype audits silently never see. Session-wide so
+    the guard trips on ANY test run, not just the analysis file's."""
+    from ddp_classification_pytorch_tpu.analysis.lint import lint_jit_sites
+
+    findings = lint_jit_sites()
+    assert not findings, (
+        "unregistered jax.jit site(s) in train/steps.py — register the "
+        "factory in jaxpr_audit.build_registry() or document it in "
+        "analysis.lint._JIT_EXEMPT:\n"
+        + "\n".join(str(f) for f in findings))

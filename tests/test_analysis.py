@@ -653,7 +653,14 @@ def test_baseline_roundtrip_and_provenance(tmp_path):
     base = baselib.load_baseline(path)
     assert base["programs"] == records
     assert base["_provenance"]["config"]["arch"] == "resnet18"
-    assert base["tolerances"] == baselib.DEFAULT_TOLERANCES
+    # the tolerances block is the sharding defaults plus the dtype pass's
+    # cast-churn band (one file fences both passes)
+    from ddp_classification_pytorch_tpu.analysis.dtype_audit import (
+        DTYPE_TOLERANCES,
+    )
+
+    assert base["tolerances"] == {**baselib.DEFAULT_TOLERANCES,
+                                  **DTYPE_TOLERANCES}
     assert baselib.diff_baseline(records, base) == []
     with pytest.raises(FileNotFoundError, match="--update-baseline"):
         baselib.load_baseline(str(tmp_path / "absent.json"))

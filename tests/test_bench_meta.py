@@ -151,6 +151,13 @@ def test_bench_e2e_row_smoke_cpu():
     assert row["donated_bytes"] > 10_000_000  # the real resnet18 state
     assert row["aliased_bytes"] == row["donated_bytes"]
     assert row["donation_coverage"] == 1.0
+
+    # dtype evidence from the same AOT window: this smoke pins f32 compute,
+    # so the FLOP-weighted bf16 fraction is 0 and the unwaivable numerics
+    # contracts (no f64, f32 accumulation/loss head, no round-trip casts)
+    # must hold on the exact compiled step
+    assert row["bf16_op_fraction"] == 0.0
+    assert row["accum_dtype_ok"] is True
     assert row["temp_bytes"] > 0
     # comms/memory evidence from the SAME compile window
     # (analysis/sharding_audit.step_comms_evidence): a dp-sharded train

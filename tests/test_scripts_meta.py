@@ -170,6 +170,7 @@ def test_lint_script_flags_match_analyze_cli():
     # the gate must run ALL pass families, on CPU, and diff the committed
     # program baseline (the sharding/comms regression fence)
     assert "jaxpr" in body and "lint" in body and "sharding" in body
+    assert "dtype" in body, "lint.sh stopped running the dtype numerics pass"
     assert "--diff-baseline" in body
     assert "JAX_PLATFORMS=cpu" in body
 
