@@ -476,7 +476,8 @@ def _e2e_metric_name(arch: str, on_accel: bool, platform: str) -> str:
 
 def _bench_e2e_row(cfg, mesh, *, steps: int, warmup: int, metric: str,
                    n_chips: int, dataset_kind: str, root: str, n_images: int,
-                   src_size: int, device_prefetch: int, num_workers: int):
+                   src_size: int, device_prefetch: int, num_workers: int,
+                   h2d_overlap: bool = False):
     """End-to-end throughput: the real `ShardedLoader → DevicePrefetcher →
     jitted train step` path against an actual dataset — the one stage
     neither the device-only rows (input excluded by design) nor
@@ -536,8 +537,12 @@ def _bench_e2e_row(cfg, mesh, *, steps: int, warmup: int, metric: str,
         return meshlib.make_global_array(host_batch, mesh, sharding=sharding)
 
     prefetcher = DevicePrefetcher(loader, mesh, depth=device_prefetch,
-                                  assemble=assemble)
+                                  assemble=assemble, overlap=h2d_overlap)
     main_ident = __import__("threading").get_ident()
+    # consumer-side input-wait evidence: time the step loop spends BLOCKED
+    # on the prefetcher (host fetch + H2D staging not keeping up) — the
+    # h2d-attributed idle the overlap mode exists to shrink
+    wait = {"s": 0.0, "n": 0}
 
     def batches():
         epoch = 0
@@ -597,7 +602,11 @@ def _bench_e2e_row(cfg, mesh, *, steps: int, warmup: int, metric: str,
             float(metrics["loss"])  # hard sync (device-get, see _bench_row)
             t0 = time.perf_counter()
             for _ in range(steps):
-                state, metrics = step(state, *next(it))
+                w0 = time.perf_counter()
+                b = next(it)
+                wait["s"] += time.perf_counter() - w0
+                wait["n"] += 1
+                state, metrics = step(state, *b)
             float(metrics["loss"])  # hard sync closes the timing window
             step_s = (time.perf_counter() - t0) / steps
     finally:
@@ -613,6 +622,19 @@ def _bench_e2e_row(cfg, mesh, *, steps: int, warmup: int, metric: str,
         "device_prefetch": device_prefetch,
         "input": input_path,
         "host_workers": num_workers,
+        # K-microbatch accumulation: the jitted step scans grad_accum
+        # microbatches into an f32 accumulator and defers the cross-replica
+        # gradient reduction to ONE collective per optimizer step, so
+        # collective_bytes_per_optimizer_step stays ~flat while per-
+        # microbatch reduction bytes fall ÷K (÷2K with the bf16 wire)
+        "grad_accum": max(int(cfg.parallel.grad_accum), 1),
+        "collective_bytes_per_optimizer_step": donation.get(
+            "collective_bytes_per_step", 0),
+        # double-buffered H2D dispatch + what the step loop actually waited
+        # on the input path (host fetch/H2D staging behind the step)
+        "h2d_overlap": bool(h2d_overlap) and device_prefetch > 0,
+        "h2d_wait_ms_per_step": round(
+            wait["s"] / max(wait["n"], 1) * 1e3, 3),
         # wire-format evidence (uint8 dataplane): observed per-step H2D
         # payload bytes + the dtype that actually crossed the wire
         "h2d_bytes_per_step": wire.get("h2d_bytes_per_step", 0),
@@ -855,6 +877,21 @@ def main() -> None:
                          "bfloat16 halves the gradient-reduction wire "
                          "payload (master params/momentum stay f32); shows "
                          "up in the e2e row's collective_bytes_per_step")
+    ap.add_argument("--grad-accum", type=int, default=1,
+                    help="parallel.grad_accum for the train rows: scan K "
+                         "microbatches per optimizer step inside the jitted "
+                         "program with ONE deferred gradient reduction, so "
+                         "the e2e row's collective_bytes_per_optimizer_step "
+                         "stays ~flat while per-microbatch reduction bytes "
+                         "fall ÷K (compose with --grad-reduce-dtype "
+                         "bfloat16 for ÷2K); K must divide the per-replica "
+                         "batch")
+    ap.add_argument("--h2d-overlap", action="store_true",
+                    help="double-buffered H2D dispatch for --e2e: fetch "
+                         "host batch N+1 on a separate thread while batch "
+                         "N's make_global_array transfer is in flight "
+                         "(one-slot in-flight budget; the row carries "
+                         "h2d_overlap + h2d_wait_ms_per_step as evidence)")
     ap.add_argument("--serve", action="store_true",
                     help="also measure the serving path: the ServingEngine "
                          "(bounded queue → deadline batcher → bucketed "
@@ -958,6 +995,7 @@ def main() -> None:
     # peak_hbm_bytes) is where their effect is machine-visible
     cfg.parallel.zero_opt = args.zero_opt
     cfg.parallel.grad_reduce_dtype = args.grad_reduce_dtype
+    cfg.parallel.grad_accum = max(args.grad_accum, 1)
     cfg.data.num_classes = 1000
     # CPU caps (not pins) the image size so smoke runs can shrink further
     cfg.data.image_size = args.image_size if on_accel else min(args.image_size, 64)
@@ -1079,11 +1117,14 @@ def main() -> None:
                     n_images=args.e2e_images, src_size=args.e2e_src_size,
                     device_prefetch=args.device_prefetch,
                     num_workers=args.e2e_workers or (os.cpu_count() or 4),
+                    h2d_overlap=args.h2d_overlap,
                 )
                 extra.append(row)
                 partial_box["row"] = dict(partial_box["row"], extra=list(extra))
                 print(f"# e2e row ({row['input']}, prefetch "
-                      f"{row['device_prefetch']}, wire {row['input_dtype']} "
+                      f"{row['device_prefetch']}, overlap "
+                      f"{row['h2d_overlap']}, accum {row['grad_accum']}, "
+                      f"wire {row['input_dtype']} "
                       f"{row['h2d_bytes_per_step']} B/step): "
                       f"{row['value']} img/s/chip, "
                       f"step {row['step_ms']}ms, staged "

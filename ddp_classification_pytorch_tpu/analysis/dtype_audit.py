@@ -58,6 +58,8 @@ from .jaxpr_audit import (
     COLLECTIVE_PRIMITIVES,
     AuditContext,
     _sub_jaxprs,
+    abstract_state,
+    batch_sharded,
     build_registry,
 )
 
@@ -530,6 +532,25 @@ def _build_train_bf16_wire_bf16_compute(ctx: AuditContext):
     return fn, (state, ctx.images(), ctx.labels())
 
 
+def _build_train_accum_bf16_wire(ctx: AuditContext):
+    """K=4 accumulation × bf16 grad wire on the composed dp2 mesh: the
+    scan's f32 accumulator is the D2/D3 subject (it must never narrow,
+    whatever the wire dtype), and the once-per-K pmean is the one
+    declared sub-f32 collective (D5 via the `bf16_wire` waiver)."""
+    from ..train.steps import make_train_step
+
+    _, model, tx, state = ctx.state_for("baseline")
+    cfg = ctx.tiny_cfg("baseline")
+    cfg.parallel.zero_opt = "off"
+    cfg.parallel.grad_reduce_dtype = "bfloat16"
+    cfg.parallel.grad_accum = 4
+    mesh = ctx.composed_mesh("dp2")
+    fn = make_train_step(cfg, model, tx, mesh=mesh)
+    return fn, (abstract_state(state, mesh, zero_opt="off"),
+                batch_sharded(ctx.images(), mesh),
+                batch_sharded(ctx.labels(), mesh))
+
+
 def _build_vit_ln_bf16(ctx: AuditContext):
     """`--ln_bf16` as a DECLARED cell: ViT eval with the LayerNorms in the
     block compute dtype — the waiver that used to be implicit in a CLI
@@ -579,6 +600,12 @@ def dtype_registry() -> List[DtypeCase]:
                   _build_train_bf16_wire_bf16_compute, train=True,
                   waivers=frozenset({WAIVER_BF16_TRUNK, WAIVER_BF16_WIRE}),
                   note="bf16 trunk + bf16 grad wire compose"),
+        DtypeCase("train_step_accum4#accum_bf16",
+                  _build_train_accum_bf16_wire, train=True,
+                  waivers=frozenset({WAIVER_BF16_WIRE}),
+                  note="K=4 scan accumulator stays f32 under the bf16 "
+                       "wire; one declared sub-f32 collective per "
+                       "optimizer step"),
         DtypeCase("vit_eval#ln_bf16", _build_vit_ln_bf16,
                   waivers=frozenset({WAIVER_BF16_TRUNK, WAIVER_LN_BF16}),
                   note="--ln_bf16 as a declared waiver, not an implicit flag"),

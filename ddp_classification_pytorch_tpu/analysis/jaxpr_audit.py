@@ -575,6 +575,28 @@ def _build_train_bf16_reduce(ctx: AuditContext):
     return fn, (state, ctx.images(), ctx.labels())
 
 
+def _build_train_accum(ctx: AuditContext):
+    """The K=4 accumulated train step (parallel.grad_accum, steps.py
+    `_accum_grad_section` + `_scan_microbatches`): a lax.scan over 4
+    microbatches with the gradient reduction deferred OUTSIDE the scan —
+    a different program (while body, f32 accumulator carry, one explicit
+    pmean per optimizer step), so it gets its own audit entry per the
+    registry NOTE. Built on the composed dp2 mesh (NOT ctx.mesh, whose
+    8-way data axis would leave a per-replica batch of 1, indivisible by
+    K=4); the uint8 epilogue runs before the (K, mb, ...) reshape, so
+    the raw-pixels→convert→/255 contract is checked through the scan."""
+    from ..train.steps import make_train_step
+
+    mesh = ctx.composed_mesh("dp2")
+    _, model, tx, state = ctx.state_for("baseline")
+    cfg = ctx.tiny_cfg("baseline")
+    cfg.parallel.grad_accum = 4
+    fn = make_train_step(cfg, model, tx, mesh=mesh)
+    return fn, (abstract_state(state, mesh),
+                batch_sharded(ctx.images(), mesh),
+                batch_sharded(ctx.labels(), mesh))
+
+
 def _build_shard_map_train(ctx: AuditContext):
     from ..parallel.collectives import build_ddp_model, make_shard_map_train_step
     from ..train.schedule import build_optimizer
@@ -705,6 +727,14 @@ def build_registry() -> List[StepSpec]:
             donate=(0,),
             uint8_input=True,
             allow_collectives=True,  # the bf16 pmean IS this program
+        ),
+        StepSpec(
+            name="train_step_accum4",
+            factory="ddp_classification_pytorch_tpu.train.steps:make_train_step",
+            build=_build_train_accum,
+            donate=(0,),
+            uint8_input=True,
+            allow_collectives=True,  # the once-per-K pmean IS this program
         ),
         StepSpec(
             name="shard_map_train_step",

@@ -752,6 +752,43 @@ def _case_train_bf16(ctx: AuditContext, mesh):
                 batch_sharded(ctx.labels(), mesh))
 
 
+def _case_train_accum(ctx: AuditContext, mesh):
+    """K=4 gradient accumulation over ZeRO-1 (`parallel.grad_accum`,
+    steps.py `_accum_grad_section`): the batch scans as 4 microbatches
+    inside the step and the data-axis gradient reduction runs ONCE per
+    optimizer step, OUTSIDE the scan's while body — so the banked payload
+    equals the K=1 anchor's while amortizing over 4× the samples-per-
+    reduction. The audit batch is 8 → per-replica 4 → microbatch 1 on
+    the 2-way data axis."""
+    from ..train.steps import make_train_step
+
+    _, model, tx, state = ctx.state_for("baseline")
+    cfg = ctx.tiny_cfg("baseline")
+    cfg.parallel.grad_accum = 4
+    fn = make_train_step(cfg, model, tx, mesh=mesh)
+    return fn, (abstract_state(state, mesh),
+                batch_sharded(ctx.images(), mesh),
+                batch_sharded(ctx.labels(), mesh))
+
+
+def _case_train_accum_bf16(ctx: AuditContext, mesh):
+    """The compound lever: K=4 accumulation × bf16 wire — ONE deferred
+    reduction per optimizer step at HALF the f32 payload (÷2K
+    per-microbatch bytes vs the K=1 f32 anchor). zero_opt off to mirror
+    `_case_train_bf16`, isolating the wire effect."""
+    from ..train.steps import make_train_step
+
+    _, model, tx, state = ctx.state_for("baseline")
+    cfg = ctx.tiny_cfg("baseline")
+    cfg.parallel.zero_opt = "off"
+    cfg.parallel.grad_reduce_dtype = "bfloat16"
+    cfg.parallel.grad_accum = 4
+    fn = make_train_step(cfg, model, tx, mesh=mesh)
+    return fn, (abstract_state(state, mesh, zero_opt="off"),
+                batch_sharded(ctx.images(), mesh),
+                batch_sharded(ctx.labels(), mesh))
+
+
 def _case_eval(ctx: AuditContext, mesh):
     from ..train.steps import make_eval_step
 
@@ -838,6 +875,19 @@ def sharded_registry() -> List[ShardedCase]:
         ShardedCase("train_step_bf16", "dp2", _case_train_bf16,
                     TRAIN_COMMS, donate=(0,), min_grad_fraction=0.5,
                     wire_dtype="bf16"),
+        # K-step accumulation cells (parallel.grad_accum=4): the banked
+        # property is ONE data-axis gradient reduction per OPTIMIZER step
+        # with the K=1 anchor's payload (per-microbatch bytes ÷K), checked
+        # against the anchors by tests/test_zero_opt.py
+        ShardedCase("train_step_accum4", "dp2", _case_train_accum,
+                    ZERO_TRAIN_COMMS, donate=(0,),
+                    opt_replicated_bytes=ZERO_OPT_REPLICATED_BYTES),
+        ShardedCase("train_step_accum4", "dp2tp2", _case_train_accum,
+                    ZERO_TRAIN_COMMS, donate=(0,),
+                    opt_replicated_bytes=ZERO_OPT_REPLICATED_BYTES),
+        ShardedCase("train_step_accum4_bf16", "dp2",
+                    _case_train_accum_bf16, TRAIN_COMMS, donate=(0,),
+                    min_grad_fraction=0.5, wire_dtype="bf16"),
     ]
 
 

@@ -59,6 +59,11 @@ def build_parser() -> argparse.ArgumentParser:
                         "background H2D stager thread (default 2; each "
                         "staged batch holds device memory; 0 = synchronous "
                         "assembly inside the step loop)")
+    d.add_argument("--h2d-overlap", dest="h2d_overlap", action="store_true",
+                   help="double-buffered H2D dispatch: fetch host batch N+1 "
+                        "on a separate thread while batch N's "
+                        "make_global_array transfer is in flight (one-slot "
+                        "in-flight budget; ignored at --device_prefetch 0)")
     d.add_argument("--image_size", type=int, default=0)
     d.add_argument("--crop_size", type=int, default=0,
                    help="train-crop / resize-short side (default 256, the "
@@ -304,6 +309,8 @@ def config_from_args(args: argparse.Namespace) -> Config:
         cfg.data.num_workers = args.num_workers
     if args.device_prefetch >= 0:
         cfg.data.device_prefetch = args.device_prefetch
+    if args.h2d_overlap:
+        cfg.data.h2d_overlap = True
     if args.image_size:
         cfg.data.image_size = args.image_size
     if args.crop_size:

@@ -43,6 +43,12 @@ class DataConfig:
     # staged batch holds device memory (~depth extra batches of HBM).
     # 0 = synchronous assembly inside the step loop (the pre-prefetch path).
     device_prefetch: int = 2
+    # double-buffered H2D dispatch (data/device_prefetch.py overlap mode):
+    # host-batch fetch and the make_global_array H2D transfer pipeline on
+    # two threads, so batch N+1's fetch overlaps batch N's in-flight
+    # transfer (one-slot in-flight budget). Ignored at device_prefetch=0,
+    # which stays bit-for-bit synchronous.
+    h2d_overlap: bool = False
     synthetic_size: int = 0  # for dataset == "synthetic"
     # H2D wire format (data/transforms.py, train/steps.py). "uint8"
     # (default): transforms emit raw uint8 HWC pixels — ¼ the host→device

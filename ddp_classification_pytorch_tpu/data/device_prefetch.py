@@ -27,6 +27,17 @@ the host batch's dtype, so the uint8 dataplane (data.input_dtype) ships
 uint8 global arrays end-to-end and each staged H2D copy moves ¼ the bytes
 of the float32 wire (the two levers compose: fewer bytes per transfer AND
 the transfer overlapped with compute).
+
+Double-buffered H2D (`overlap=True`, config `data.h2d_overlap`): the single
+stager thread serializes host-batch FETCH (pulling the ShardedLoader,
+collation) with the H2D TRANSFER (`make_global_array`) — batch N+1's fetch
+waits for batch N's transfer. Overlap mode splits them onto two threads —
+a fetcher feeding a ONE-SLOT handoff queue (the bounded in-flight transfer
+budget: at most one batch fetched ahead of the transfer in flight) and an
+`h2d-stager` running assemble — so batch N+1's host fetch proceeds while
+batch N's transfer is in flight. Same order, same calls, same error/
+teardown discipline (BOTH threads are joined on exit, even mid-transfer);
+depth 0 ignores the flag and stays bit-for-bit synchronous.
 """
 
 from __future__ import annotations
@@ -50,6 +61,9 @@ class DevicePrefetcher:
         Runs ON THE STAGER THREAD, so per-batch host work placed here (e.g.
         the eval path's `valid_mask`) also leaves the critical path. Must
         be thread-safe with respect to the consumer.
+    overlap: double-buffered H2D dispatch — fetch host batch N+1 on a
+        separate thread while batch N's assemble/H2D transfer is in
+        flight (one-slot in-flight budget). Ignored at depth 0.
     """
 
     def __init__(
@@ -59,6 +73,7 @@ class DevicePrefetcher:
         *,
         depth: int = 2,
         assemble: Optional[Callable[[int, Any], Any]] = None,
+        overlap: bool = False,
     ):
         if assemble is None:
             if mesh is None:
@@ -69,11 +84,15 @@ class DevicePrefetcher:
         self.host = host_batches
         self.depth = max(int(depth), 0)
         self._assemble = assemble
+        self.overlap = bool(overlap)
         # introspection for tests/benchmarks: total batches staged across
         # all passes, and the ident of the active stager thread (None while
-        # synchronous) — cheap evidence of WHERE staging ran
+        # synchronous) — cheap evidence of WHERE staging ran. In overlap
+        # mode `stager_thread` is the h2d-stager (the thread running
+        # assemble) and `fetch_thread` the host-batch fetcher.
         self.staged = 0
         self.stager_thread: Optional[int] = None
+        self.fetch_thread: Optional[int] = None
 
     @staticmethod
     def _default_assemble(mesh) -> Callable[[int, Any], Any]:
@@ -90,8 +109,9 @@ class DevicePrefetcher:
     def __iter__(self) -> Iterator[Any]:
         if self.depth == 0:
             # synchronous fallback: identical assembly calls in identical
-            # order, inline on the consumer thread
+            # order, inline on the consumer thread (overlap ignored)
             self.stager_thread = None
+            self.fetch_thread = None
             for i, hb in enumerate(self.host):
                 out = self._assemble(i, hb)
                 self.staged += 1
@@ -102,40 +122,102 @@ class DevicePrefetcher:
         stop = threading.Event()
         error: list = []
 
-        def put_or_stop(item) -> bool:
+        def put_or_stop(qq, item) -> bool:
             """Bounded put that gives up when the consumer abandoned us —
-            never deadlocks the stager on a full queue at teardown."""
+            never deadlocks a producer on a full queue at teardown."""
             while not stop.is_set():
                 try:
-                    q.put(item, timeout=0.1)
+                    qq.put(item, timeout=0.1)
                     return True
                 except queue.Full:
                     continue
             return False
 
-        def stager():
-            it = iter(self.host)
-            try:
-                for i, hb in enumerate(it):
-                    if stop.is_set():
-                        return
-                    staged = self._assemble(i, hb)
-                    self.staged += 1
-                    if not put_or_stop(staged):
-                        return
-            except BaseException as e:  # re-raised at the iteration site
-                error.append(e)
-            finally:
-                # unwind the host iterator NOW (a ShardedLoader pass has its
-                # own producer thread + queue) rather than at GC time
-                close = getattr(it, "close", None)
-                if close is not None:
-                    close()
-                put_or_stop(None)
+        threads = []
+        if self.overlap:
+            # double-buffered H2D: fetch and transfer pipeline on two
+            # threads. hq's ONE slot is the in-flight transfer budget —
+            # at most one host batch fetched ahead of the assemble in
+            # flight (plus the one in the fetcher's hand), so overlap
+            # never grows host memory unboundedly.
+            hq: "queue.Queue" = queue.Queue(maxsize=1)
 
-        t = threading.Thread(target=stager, daemon=True, name="device-stager")
-        t.start()
-        self.stager_thread = t.ident
+            def fetcher():
+                it = iter(self.host)
+                try:
+                    for i, hb in enumerate(it):
+                        if stop.is_set():
+                            return
+                        if not put_or_stop(hq, (i, hb)):
+                            return
+                except BaseException as e:  # surfaces at the iteration site
+                    error.append(e)
+                finally:
+                    close = getattr(it, "close", None)
+                    if close is not None:
+                        close()
+                    put_or_stop(hq, None)
+
+            def h2d():
+                try:
+                    while True:
+                        try:
+                            item = hq.get(timeout=0.1)
+                        except queue.Empty:
+                            if stop.is_set():
+                                return
+                            continue
+                        if item is None:
+                            return
+                        i, hb = item
+                        staged = self._assemble(i, hb)
+                        self.staged += 1
+                        if not put_or_stop(q, staged):
+                            return
+                except BaseException as e:
+                    error.append(e)
+                finally:
+                    put_or_stop(q, None)
+
+            tf = threading.Thread(target=fetcher, daemon=True,
+                                  name="host-fetcher")
+            th = threading.Thread(target=h2d, daemon=True,
+                                  name="h2d-stager")
+            tf.start()
+            th.start()
+            self.fetch_thread = tf.ident
+            self.stager_thread = th.ident
+            threads = [tf, th]
+            drains = [q, hq]
+        else:
+            def stager():
+                it = iter(self.host)
+                try:
+                    for i, hb in enumerate(it):
+                        if stop.is_set():
+                            return
+                        staged = self._assemble(i, hb)
+                        self.staged += 1
+                        if not put_or_stop(q, staged):
+                            return
+                except BaseException as e:  # re-raised at the iteration site
+                    error.append(e)
+                finally:
+                    # unwind the host iterator NOW (a ShardedLoader pass has
+                    # its own producer thread + queue) rather than at GC time
+                    close = getattr(it, "close", None)
+                    if close is not None:
+                        close()
+                    put_or_stop(q, None)
+
+            t = threading.Thread(target=stager, daemon=True,
+                                 name="device-stager")
+            t.start()
+            self.fetch_thread = None
+            self.stager_thread = t.ident
+            threads = [t]
+            drains = [q]
+
         try:
             while True:
                 item = q.get()
@@ -148,14 +230,18 @@ class DevicePrefetcher:
                 raise error[0]
         finally:
             stop.set()
-            # drain so a stager blocked on a full queue can exit, then JOIN
-            # it: generator close (the trainer loops' try/finally) must not
-            # return with a stager still staging H2D copies — a leaked
-            # thread would race the next epoch's pass (or a supervise.sh
-            # restart) for device memory
-            while True:
-                try:
-                    q.get_nowait()
-                except queue.Empty:
-                    break
-            t.join(timeout=10.0)
+            # drain so a producer blocked on a full queue can exit, then
+            # JOIN every pipeline thread (overlap mode: fetcher AND the
+            # h2d-stager, even one mid-transfer): generator close (the
+            # trainer loops' try/finally, the sentinel's rc-8 drain, a
+            # SIGTERM unwind) must not return with a thread still staging
+            # H2D copies — a leaked thread would race the next epoch's
+            # pass (or a supervise.sh restart) for device memory
+            for qq in drains:
+                while True:
+                    try:
+                        qq.get_nowait()
+                    except queue.Empty:
+                        break
+            for t in threads:
+                t.join(timeout=10.0)

@@ -15,6 +15,15 @@ with microsecond `ts`/`dur` on per-thread lanes, including one span per
    deliberately NOT guessed — unaccounted window time lands in `idle`,
    so the six buckets always sum to the step wall time exactly.
 
+The per-lane interval union makes the breakdown K-accumulation-proof:
+under `parallel.grad_accum` K > 1 one StepTraceAnnotation window (one
+OPTIMIZER step) contains K scanned fwd/bwd microbatch executions and a
+single deferred gradient reduction — K disjoint same-lane fwd spans sum,
+nested/overlapping ones union, and the six buckets still cover the wall
+time exactly. The amortized collective lane is the visible win: one
+reduction's microseconds per window instead of K of them
+(tests/test_obs.py::test_parse_accum_window_buckets_and_amortization).
+
 The CPU-safe fallback is `SpanRecorder`: bench's sub-program probes (a
 forward-only and a forward+backward compile of the SAME loss — see
 train/steps.py::make_phase_probes) yield host-measured phase durations,

@@ -394,10 +394,13 @@ class Trainer:
     # ---------------------------------------------------------------- train --
     def _device_prefetcher(self, loader, assemble=None) -> DevicePrefetcher:
         """Staged-batch view of `loader` at the configured depth: batch
-        assembly + H2D run on a stager thread (depth 0 = inline)."""
+        assembly + H2D run on a stager thread (depth 0 = inline). With
+        `data.h2d_overlap`, fetch and H2D transfer additionally pipeline
+        on two threads (double-buffered dispatch)."""
         return DevicePrefetcher(loader, self.mesh,
                                 depth=self.cfg.data.device_prefetch,
-                                assemble=assemble)
+                                assemble=assemble,
+                                overlap=self.cfg.data.h2d_overlap)
 
     def train_epoch(self, epoch: int, eta: Optional[EtaLogger] = None) -> Dict[str, float]:
         self.train_loader.set_epoch(epoch)

@@ -94,10 +94,12 @@ def build_optimizer(
     freeze_bn: bool = False,
     grad_accum: int = 1,
 ) -> optax.GradientTransformationExtraArgs:
-    # with accumulation the schedule advances once per OPTIMIZER step, so the
-    # per-epoch schedule length shrinks by the accumulation factor
-    sched_steps = max(steps_per_epoch // max(grad_accum, 1), 1)
-    schedule = build_schedule(cfg, sched_steps, grad_accum=grad_accum)
+    # The accumulated train step (steps.py `_scan_microbatches`) scans its K
+    # microbatches INSIDE one jitted step and applies ONE optimizer update
+    # per loader batch — so steps_per_epoch already counts optimizer steps
+    # and the schedule needs no rescaling. Only warmup_iters, specified in
+    # reference ITERATIONS, rescales (inside build_schedule).
+    schedule = build_schedule(cfg, steps_per_epoch, grad_accum=grad_accum)
 
     if cfg.head_lr is not None or cfg.head_weight_decay is not None:
         # Two param groups in one optimizer (arc_main.py:248-253): the head
@@ -109,7 +111,8 @@ def build_optimizer(
             weight_decay=(cfg.weight_decay if cfg.head_weight_decay is None
                           else cfg.head_weight_decay),
         )
-        head_sched = build_schedule(head_cfg, sched_steps, grad_accum=grad_accum)
+        head_sched = build_schedule(head_cfg, steps_per_epoch,
+                                    grad_accum=grad_accum)
 
         def label_fn(params):
             if not any(k in HEAD_GROUP_KEYS for k in params):
@@ -146,7 +149,7 @@ def build_optimizer(
             sched = cdr_clip_schedule(cfg.noise_rate, cfg.num_gradual,
                                       cfg.num_gradual, dead_schedule=False)
             parts.append(cdr_gradient_transform(
-                nz, clip_schedule=sched, steps_per_epoch=sched_steps))
+                nz, clip_schedule=sched, steps_per_epoch=steps_per_epoch))
     # weight decay lives inside each group's transform (_group_tx)
     parts.append(base)
     if freeze_bn:
@@ -158,10 +161,9 @@ def build_optimizer(
                 lambda params: jax.tree_util.tree_map_with_path(_is_bn_param, params),
             )
         )
-    tx = optax.chain(*parts)
-    if grad_accum > 1:
-        # microbatch accumulation (capability headroom over the reference,
-        # which has none — SURVEY §2.2): k micro-steps average into one
-        # optimizer step, all inside the jitted update
-        tx = optax.MultiSteps(tx, every_k_schedule=grad_accum)
-    return optax.with_extra_args_support(tx)
+    # No optax.MultiSteps wrapper for grad_accum: accumulation lives in the
+    # train step's microbatch scan (steps.py), which hands this transform
+    # ONE summed-mean gradient per optimizer step — wrapping would divide
+    # the schedule by K a second time (the classic off-by-K accumulation
+    # bug the LR-trace test pins).
+    return optax.with_extra_args_support(optax.chain(*parts))

@@ -7,6 +7,14 @@ cannot poison the weights (AMP-style skip-step semantics). The `step_ok`
 flag and `grad_norm` ride the existing per-step metrics dict, so the
 check costs no extra host sync.
 
+Under gradient accumulation (parallel.grad_accum K > 1) the check sits at
+the OPTIMIZER boundary: the jitted step scans K microbatches into the f32
+grad accumulator and the all-finite gate inspects the SUMMED gradients
+once, after the deferred cross-replica reduction. One `observe` per
+optimizer step, never per microbatch — a single non-finite microbatch
+skips (identity-updates) the whole accumulated step, and max_bad_steps
+keeps counting optimizer steps regardless of K.
+
 This module is the policy layer on top of that flag:
 
 - `StepSentinel.observe` collects the per-step device flags without

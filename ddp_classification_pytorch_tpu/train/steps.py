@@ -148,7 +148,20 @@ def make_train_step(
     of the optimizer HBM. `parallel.grad_reduce_dtype=bfloat16`
     additionally routes fwd/bwd through a shard_map section that casts
     gradients to bf16 for ONE cross-replica mean (half the wire payload)
-    and accumulates back into the f32 master params."""
+    and accumulates back into the f32 master params.
+
+    `parallel.grad_accum=K` (default 1 = exactly today's program — the
+    dispatch is static, so K=1 compiles the legacy HLO byte-for-byte)
+    turns the step into a K-microbatch ACCUMULATED step: the batch
+    reshapes to (K, mb, ...) and a `lax.scan` runs the same loss/grad
+    per microbatch into an f32 accumulator; the cross-replica gradient
+    reduction (f32, or the bf16 wire — they compose for a ÷2K payload),
+    the ZeRO-1 reduce-scatter → update → all-gather, and the sentinel's
+    all-finite gate all run ONCE per K microbatches, at the optimizer
+    boundary. Construction rejects (`grad-accum-indivisible`) a
+    per-replica batch K cannot slice evenly, and composition with the
+    pipeline schedule or `arcface_sharded_ce` (each already owns its own
+    microbatch loop)."""
     from ..parallel.mesh import DATA_AXIS, zero_opt_enabled
 
     workload = cfg.model.head
@@ -165,6 +178,10 @@ def make_train_step(
             f"{reduce_dtype!r}")
     want_bf16 = (reduce_dtype == "bfloat16" and mesh is not None
                  and dict(mesh.shape).get(DATA_AXIS, 1) > 1)
+
+    grad_accum = max(int(cfg.parallel.grad_accum), 1)
+    if grad_accum > 1:
+        _require_accum_compatible(cfg, mesh, grad_accum)
 
     if cfg.parallel.arcface_sharded_ce and workload == "arcface":
         if want_bf16:
@@ -194,12 +211,51 @@ def make_train_step(
                 "grad_reduce_dtype=bfloat16 is the pure-DP fast path; it "
                 "does not compose with a model/pipe axis — use float32 "
                 "reduction there")
-        grad_section = _reduced_grad_section(cfg, mesh, jnp.bfloat16)
+        grad_section = (_accum_grad_section(cfg, mesh, grad_accum,
+                                            jnp.bfloat16)
+                        if grad_accum > 1
+                        else _reduced_grad_section(cfg, mesh, jnp.bfloat16))
+    elif grad_accum > 1 and mesh is not None:
+        # f32-wire accumulation: the same deferred-reduction section with
+        # the summed gradients crossing replicas once at float32
+        grad_section = _accum_grad_section(cfg, mesh, grad_accum,
+                                           jnp.float32)
 
     return _build_step(tx, base_rng, _dense_loss_fn(cfg, model),
                        lambda loss, logits, labels: _train_metrics(loss, logits, labels),
                        chaos=chaos, flip=flip, mesh=mesh, zero=zero,
-                       grad_section=grad_section)
+                       grad_section=grad_section, grad_accum=grad_accum)
+
+
+def _require_accum_compatible(cfg: Config, mesh, grad_accum: int) -> None:
+    """Up-front `grad-accum-indivisible` rejections (rc 2 through
+    cli.train's config-error mapping, mirroring the grad_reduce_dtype
+    pattern). Every microbatch must be the same size on every data
+    replica — a ragged last microbatch would silently re-weight its
+    samples' gradients — and grad_accum cannot compose with programs
+    that already own their own microbatch loop."""
+    from ..parallel.mesh import DATA_AXIS
+
+    if (max(cfg.parallel.pipeline_stages, 1) > 1
+            or cfg.parallel.pipeline_microbatches > 0):
+        raise ValueError(
+            "grad-accum-indivisible: grad_accum > 1 does not compose with "
+            "the pipeline schedule (pipeline_microbatches already owns the "
+            "microbatch loop) — pick one microbatching scheme")
+    if cfg.parallel.arcface_sharded_ce and cfg.model.head == "arcface":
+        raise ValueError(
+            "grad-accum-indivisible: grad_accum > 1 does not compose with "
+            "arcface_sharded_ce (the partial-FC loss is its own shard_map "
+            "program whose batch the accumulation scan cannot slice) — "
+            "drop one of the two")
+    dp = dict(mesh.shape).get(DATA_AXIS, 1) if mesh is not None else 1
+    batch = cfg.data.batch_size
+    if batch % dp or (batch // dp) % grad_accum:
+        raise ValueError(
+            f"grad-accum-indivisible: per-replica batch {batch}/{dp} does "
+            f"not split into grad_accum={grad_accum} equal microbatches — "
+            "pick K dividing batch_size/dp (equal microbatches keep the "
+            "accumulated mean exact)")
 
 
 def _dense_loss_fn(cfg: Config, model: Any):
@@ -361,6 +417,133 @@ def _reduced_grad_section(cfg: Config, mesh: Any, reduce_dtype: Any):
         out_specs=(P(), P(), P(DATA_AXIS), P()))
 
 
+def _scan_microbatches(loss_fn, grad_accum, params, batch_stats, images,
+                       labels, rng):
+    """K-microbatch accumulation core: reshape the batch to (K, mb, ...)
+    and `lax.scan` `loss_fn(params, stats, x, y, r) -> (loss, (stats,
+    logits))` over the leading axis, summing per-microbatch MEAN gradients
+    into a float32 accumulator (D2/D3: the accumulator never narrows below
+    f32 regardless of the wire dtype). Equal microbatches make
+    sum-of-means ÷ K exactly the full-batch mean, so the accumulated step
+    is arithmetic-identical to the K=1 large-batch step up to summation
+    order. BN statistics thread through the carry — each microbatch
+    normalizes with the stats the previous one produced, the same
+    semantics as running the K microbatches as K separate steps without an
+    optimizer update in between. The per-microbatch rng is
+    `fold_in(rng, i)`: deterministic, and distinct flip/dropout/mask draws
+    per microbatch.
+
+    Returns `(mean_loss, final_stats, logits (B, C), mean_grads)` with
+    gradients in float32 — the caller owns the (single, deferred)
+    cross-replica reduction and any wire cast."""
+    k = int(grad_accum)
+    batch = images.shape[0]
+    if batch % k:
+        raise ValueError(
+            f"grad-accum-indivisible: batch {batch} does not split into "
+            f"grad_accum={k} equal microbatches")
+    mb = batch // k
+    xs = images.reshape((k, mb) + images.shape[1:])
+    ys = labels.reshape((k, mb) + labels.shape[1:])
+
+    def body(carry, sl):
+        stats, gsum, loss_sum = carry
+        i, x, y = sl
+        r = jax.random.fold_in(rng, i)
+        (loss, (new_stats, logits)), grads = jax.value_and_grad(
+            loss_fn, has_aux=True)(params, stats, x, y, r)
+        gsum = jax.tree_util.tree_map(
+            lambda a, g: a + g.astype(jnp.float32), gsum, grads)
+        return (new_stats, gsum, loss_sum + loss.astype(jnp.float32)), logits
+
+    gsum0 = jax.tree_util.tree_map(
+        lambda p: jnp.zeros(p.shape, jnp.float32), params)
+    (new_stats, gsum, loss_sum), logits = jax.lax.scan(
+        body, (batch_stats, gsum0, jnp.zeros((), jnp.float32)),
+        (jnp.arange(k), xs, ys))
+    mean_grads = jax.tree_util.tree_map(lambda g: g / k, gsum)
+    return (loss_sum / k, new_stats,
+            logits.reshape((batch,) + logits.shape[2:]), mean_grads)
+
+
+def _accum_grad_section(cfg: Config, mesh: Any, grad_accum: int,
+                        reduce_dtype: Any):
+    """The K-microbatch analogue of `_reduced_grad_section`: each data
+    shard scans `grad_accum` microbatches of its batch slice through the
+    same fwd/bwd (`_scan_microbatches`) and the cross-replica gradient
+    exchange happens ONCE per optimizer step, outside the scan — so the
+    reduction payload is the K=1 anchor's, amortized over K microbatches
+    (÷K per-microbatch bytes; ÷2K when `reduce_dtype` is bf16). A
+    GSPMD-partitioned scan would instead sink the all-reduce INTO the
+    while body — one op in HLO text but K executions at runtime — which is
+    exactly the dishonesty this explicit section exists to rule out.
+
+    SyncBN stat reductions still ride the axis-named model inside the
+    scan body (per-microbatch, per-channel — control-sized next to the
+    gradient payload). The nested workload IS supported here (unlike the
+    K=1 bf16 section, whose rejection predates this path): the rng enters
+    replicated and the microbatch fold is deterministic, so every shard
+    draws the same global per-microbatch mask k.
+
+    Returns `(params, stats, images, labels, rng) ->
+    (loss, new_stats, logits, grads)`, loss pmean'd, logits
+    batch-sharded."""
+    from ..parallel.collectives import build_ddp_model
+    from ..parallel.mesh import DATA_AXIS
+    from ..utils.compat import shard_map_unchecked
+    from jax.sharding import PartitionSpec as P
+
+    workload = cfg.model.head
+    model = build_ddp_model(cfg)
+    if workload == "nested":
+        dist = jnp.asarray(gaussian_dist(0.0, cfg.model.nested_std,
+                                         feat_dim_for(cfg.model)))
+        feat_dim = feat_dim_for(cfg.model)
+
+    def per_shard(params, batch_stats, images, labels, rng):
+        def loss_fn(p, s, x, y, r):
+            variables = {"params": p, "batch_stats": s}
+            mask_rng, drop_rng = jax.random.split(r)  # dense derivation
+            drop_rng = jax.random.fold_in(
+                drop_rng, jax.lax.axis_index(DATA_AXIS))
+            kwargs = dict(train=True, mutable=["batch_stats", "losses"],
+                          rngs={"dropout": drop_rng})
+            if workload == "arcface":
+                logits, mutated = model.apply(variables, x, y, **kwargs)
+            elif workload == "nested":
+                # mask_rng is replicated (rng enters at P()) and the
+                # microbatch fold is shard-independent: one global k per
+                # microbatch, as NESTED/train.py:247-250 samples it
+                mk = sample_mask_dims(mask_rng, dist)
+                mask = prefix_mask(mk, feat_dim)
+                logits, mutated = model.apply(variables, x, mask, **kwargs)
+            else:
+                logits, mutated = model.apply(variables, x, **kwargs)
+            loss = _cross_entropy(logits, y)
+            aux = sum(jax.tree_util.tree_leaves(mutated.get("losses", {})))
+            if cfg.model.moe_aux_weight:
+                loss = loss + cfg.model.moe_aux_weight * aux
+            return loss, (mutated.get("batch_stats", s), logits)
+
+        loss, new_stats, logits, grads = _scan_microbatches(
+            loss_fn, grad_accum, params, batch_stats, images, labels, rng)
+        grads = jax.tree_util.tree_map(
+            lambda g: g.astype(reduce_dtype), grads)
+        # THE deferred reduction: one cross-replica mean of the summed
+        # per-shard mean grads per optimizer step (pmean of per-shard
+        # means == grad of the global mean for equal shards)
+        grads = jax.lax.pmean(grads, DATA_AXIS)
+        grads = jax.tree_util.tree_map(
+            lambda g, p: g.astype(p.dtype), grads, params)
+        loss = jax.lax.pmean(loss, DATA_AXIS)
+        return loss, new_stats, logits, grads
+
+    return shard_map_unchecked(
+        per_shard, mesh=mesh,
+        in_specs=(P(), P(), P(DATA_AXIS), P(DATA_AXIS), P()),
+        out_specs=(P(), P(), P(DATA_AXIS), P()))
+
+
 def _constrain_state(state: TrainState, mesh: Any, zero: bool) -> TrainState:
     """Pin the new state's output shardings to the declared layout
     (params/pipe/model rules, ZeRO data-axis optimizer shards, replicated
@@ -389,7 +572,7 @@ def _constrain_state(state: TrainState, mesh: Any, zero: bool) -> TrainState:
 
 
 def _build_step(tx, base_rng, loss_fn, metrics_fn, chaos=None, flip=False,
-                mesh=None, zero=False, grad_section=None):
+                mesh=None, zero=False, grad_section=None, grad_accum=1):
     """Shared optimizer-update skeleton for every train step: fold_in rng,
     value_and_grad over `loss_fn(params, stats, images, labels, rng) ->
     (loss, (new_stats, aux))`, apply updates, metrics via
@@ -416,10 +599,16 @@ def _build_step(tx, base_rng, loss_fn, metrics_fn, chaos=None, flip=False,
     (`_constrain_state`) so donation stays whole. With zero=False and no
     grad_section the program is bit-identical to the pre-ZeRO step.
 
-    `grad_section` (from `_reduced_grad_section`) replaces the in-jit
-    value_and_grad with an explicit shard_map fwd/bwd whose gradient
-    exchange runs at a reduced wire dtype; `loss_fn` is then unused for
-    the step but still times the phase probes."""
+    `grad_section` (from `_reduced_grad_section` or, with accumulation,
+    `_accum_grad_section`) replaces the in-jit value_and_grad with an
+    explicit shard_map fwd/bwd whose gradient exchange runs once per
+    optimizer step at the wire dtype; `loss_fn` is then unused for the
+    step but still times the phase probes. `grad_accum > 1` without a
+    mesh scans the microbatches locally (`_scan_microbatches`) — no
+    collectives, same accumulate-then-update arithmetic. The non-finite
+    gate below always inspects the SUMMED gradients at the optimizer
+    boundary: one sentinel observation per optimizer step, however many
+    microbatches fed it."""
     nan_windows = list(chaos.windows("nan_loss", "step")) if chaos else []
 
     def step(state: TrainState, images: jnp.ndarray, labels: jnp.ndarray):
@@ -428,10 +617,17 @@ def _build_step(tx, base_rng, loss_fn, metrics_fn, chaos=None, flip=False,
         rng = jax.random.fold_in(base_rng, state.step)
         # uint8 wire → f32 (+ per-sample device flip); f32 wire untouched.
         # Outside value_and_grad: images carry no parameter gradient.
+        # Runs BEFORE any (K, mb, ...) reshape — the uint8 epilogue audit
+        # requires raw pixels to flow straight into convert → /255.
         images = device_input_epilogue(images, rng, flip=flip)
         if grad_section is not None:
             loss, new_stats, aux, grads = grad_section(
                 state.params, state.batch_stats, images, labels, rng)
+        elif grad_accum > 1:
+            # meshless accumulation: scan microbatches on the one device
+            loss, new_stats, aux, grads = _scan_microbatches(
+                loss_fn, grad_accum, state.params, state.batch_stats,
+                images, labels, rng)
         else:
             (loss, (new_stats, aux)), grads = jax.value_and_grad(loss_fn, has_aux=True)(
                 state.params, state.batch_stats, images, labels, rng

@@ -60,6 +60,35 @@ grep -q '"cold_start_ms"' "$out/bench.json" \
 grep -q '"aot_cache_hit": true' "$out/bench.json" \
   || echo ">> aot_cache_hit not true — warm boot recompiled instead of deserializing" >&2
 
+echo "== 1b/2 grad-accum comms A/B (new in r16): K∈{1,4} × wire" >&2
+echo "   {f32,bf16} e2e rows — the ÷K / ÷2K amortization of" >&2
+echo "   collective_bytes_per_optimizer_step on real DCN-adjacent" >&2
+echo "   hardware, plus the double-buffered H2D overlap's" >&2
+echo "   h2d_wait_ms_per_step delta (docs/performance.md 'Gradient" >&2
+echo "   accumulation and comms amortization')" >&2
+# K=1 f32 is step 1's bench.json; the three remaining corners each get a
+# short --e2e-only capture (same batch, so the per-optimizer-step payload
+# comparison is like-for-like; --h2d-overlap on the K=4 rows also banks
+# the overlap evidence). A failed corner warns and continues — the A/B
+# must not cost the queued ViT/VGG work.
+for corner in "accum4_f32:--grad-accum 4 --h2d-overlap" \
+              "accum1_bf16:--grad-reduce-dtype bfloat16 --zero-opt off" \
+              "accum4_bf16:--grad-accum 4 --grad-reduce-dtype bfloat16 --zero-opt off --h2d-overlap"; do
+  name=${corner%%:*}; flags=${corner#*:}
+  # shellcheck disable=SC2086
+  python bench.py --e2e --steps 20 --rows "" $flags \
+      > "$out/bench_$name.json" 2> "$out/bench_$name.log"
+  crc=$?
+  if [ $crc -ne 0 ]; then
+    case $crc in
+      3|5) echo "bench_$name rc=$crc — backend outage, stopping" >&2; exit $crc ;;
+      *) echo "bench_$name rc=$crc (non-outage) — continuing" >&2 ;;
+    esac
+  else
+    tail -1 "$out/bench_$name.json"
+  fi
+done
+
 echo ">> if step_ms is ~48 and probe.matmul20_ms is fresh, pin" >&2
 echo ">> PROBE_UNCONTENDED_MS in bench.py to that probe value (and mirror" >&2
 echo ">> the capture into docs/performance.md — tests/test_bench_meta.py" >&2

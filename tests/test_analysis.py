@@ -246,7 +246,11 @@ def test_registry_names_every_step_program():
                      "plc_predict_dp_tp", "topk_predict_dp_tp",
                      # the dp-sharded serving predict (serve mesh assembles
                      # data-sharded global batches; docs/serving.md)
-                     "topk_predict_serve_dp", "topk_predict_serve_dp_tp"}
+                     "topk_predict_serve_dp", "topk_predict_serve_dp_tp",
+                     # the K-microbatch accumulated step (--grad_accum 4):
+                     # lax.scan over microbatches, ONE deferred data-axis
+                     # gradient reduction per optimizer step
+                     "train_step_accum4"}
     for spec in build_registry():
         # every entry either donates or documents why it must not
         assert spec.donate or spec.no_donate_reason, spec.name

@@ -11,6 +11,8 @@ import re
 import subprocess
 import sys
 
+import pytest
+
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
@@ -97,7 +99,7 @@ def test_bench_cli_has_e2e_flags():
     helptext = p.stdout.decode()
     for flag in ("--e2e", "--e2e-dataset", "--e2e-images", "--e2e-root",
                  "--device-prefetch", "--e2e-workers", "--input-dtype",
-                 "--trace"):
+                 "--trace", "--grad-accum", "--h2d-overlap"):
         assert flag in helptext, flag
 
 
@@ -165,6 +167,15 @@ def test_bench_e2e_row_smoke_cpu():
     # peak HBM exceeds the donated state it updates in place
     assert row["collective_bytes_per_step"] > 0
     assert row["peak_hbm_bytes"] > row["donated_bytes"]
+    # grad-accum / H2D-overlap schema lock: the defaults report K=1, the
+    # per-optimizer-step payload aliases the per-step payload (one
+    # optimizer step per compiled program), overlap off, and the
+    # consumer-side input wait is measured
+    assert row["grad_accum"] == 1
+    assert (row["collective_bytes_per_optimizer_step"]
+            == row["collective_bytes_per_step"])
+    assert row["h2d_overlap"] is False
+    assert row["h2d_wait_ms_per_step"] >= 0
 
 
 def test_bench_row_trace_breakdown_cpu():
@@ -320,3 +331,45 @@ def test_watchdog_disarm_prevents_exit():
                        capture_output=True, timeout=25)
     assert p.returncode == 0, p.stderr[-300:]
     assert b"survived" in p.stdout
+
+
+@pytest.mark.slow
+def test_bench_e2e_row_accum_overlap_smoke():
+    """The K-accumulation + double-buffered-H2D e2e row (`bench.py --e2e
+    --grad-accum 4 --h2d-overlap`): one jitted optimizer step scans K=4
+    microbatches, the prefetcher pipelines fetch behind the transfer, and
+    the row carries the evidence fields the TPU worklist A/B keys on.
+    Slow-marked (full e2e boot + a K=4 scan compile): the fast e2e smoke
+    above already locks the new row fields at K=1, and the overlap
+    thread mechanics are tier-1 in test_device_prefetch.py."""
+    import jax
+
+    import bench
+    from ddp_classification_pytorch_tpu.config import get_preset
+    from ddp_classification_pytorch_tpu.parallel import mesh as meshlib
+
+    cfg = get_preset("baseline")
+    cfg.model.arch = "resnet18"
+    cfg.model.variant = "cifar"
+    cfg.model.dtype = "float32"
+    cfg.data.num_classes = 8
+    cfg.data.image_size = 32
+    cfg.data.batch_size = 32  # dp=8 -> per-replica 4 -> K=4 x mb=1
+    cfg.parallel.grad_accum = 4
+    mesh = meshlib.make_mesh()
+    row = bench._bench_e2e_row(
+        cfg, mesh, steps=2, warmup=1,
+        metric=bench._e2e_metric_name("resnet18", False, "cpu"),
+        n_chips=len(jax.devices()), dataset_kind="synthetic", root="",
+        n_images=64, src_size=0, device_prefetch=2, num_workers=2,
+        h2d_overlap=True)
+
+    assert row["value"] > 0
+    assert row["grad_accum"] == 4
+    assert row["h2d_overlap"] is True
+    assert row["h2d_wait_ms_per_step"] >= 0
+    assert row["staged_off_thread"] is True
+    # the accumulated program still reduces gradients (and fully aliases
+    # its donated state) ONCE per optimizer step
+    assert row["collective_bytes_per_optimizer_step"] > 0
+    assert row["donation_coverage"] == 1.0

@@ -159,6 +159,144 @@ def test_requires_mesh_or_assemble():
         DevicePrefetcher(_loader())
 
 
+# ---------------------------------------------------- double-buffered H2D --
+
+def _gone(*names, deadline_s=5.0):
+    """True once no live thread carries any of the given names."""
+    deadline = time.time() + deadline_s
+    while time.time() < deadline:
+        if not any(t.name in names and t.is_alive()
+                   for t in threading.enumerate()):
+            return True
+        time.sleep(0.05)
+    return False
+
+
+def test_overlap_batches_bit_identical_and_in_order():
+    loader = _loader()
+    mesh = meshlib.make_mesh()
+    sync = [_get(b) for b in DevicePrefetcher(loader, mesh, depth=0)]
+    over = [_get(b) for b in DevicePrefetcher(loader, mesh, depth=2,
+                                              overlap=True)]
+    assert len(sync) == len(over) == len(loader)
+    for (si, sl), (oi, ol) in zip(sync, over):
+        np.testing.assert_array_equal(si, oi)
+        np.testing.assert_array_equal(sl, ol)
+
+
+def test_overlap_splits_fetch_and_h2d_onto_distinct_threads():
+    """The dispatch evidence: host-batch fetch and assemble/H2D run on
+    two different named threads, neither of them the consumer; at depth 0
+    the flag is ignored bit-for-bit (inline, no threads)."""
+    fetch_idents = []
+    h2d_idents = []
+
+    class Spy:
+        def __init__(self, host):
+            self.host = host
+
+        def __iter__(self):
+            for hb in self.host:
+                fetch_idents.append(threading.get_ident())
+                yield hb
+
+    def assemble(i, hb):
+        h2d_idents.append(threading.get_ident())
+        return hb
+
+    pre = DevicePrefetcher(Spy(_loader(n=32, batch=8)), depth=2,
+                           assemble=assemble, overlap=True)
+    list(pre)
+    assert pre.staged == 4
+    assert pre.fetch_thread is not None and pre.stager_thread is not None
+    assert pre.fetch_thread != pre.stager_thread
+    assert set(fetch_idents) == {pre.fetch_thread}
+    assert set(h2d_idents) == {pre.stager_thread}
+    assert threading.get_ident() not in fetch_idents + h2d_idents
+
+    # depth 0 ignores overlap: inline, synchronous, no thread idents
+    h2d_idents.clear()
+    sync = DevicePrefetcher(_loader(n=32, batch=8), depth=0,
+                            assemble=assemble, overlap=True)
+    list(sync)
+    assert sync.stager_thread is None and sync.fetch_thread is None
+    assert set(h2d_idents) == {threading.get_ident()}
+
+
+def test_overlap_pipelines_fetch_behind_transfer():
+    """The deterministic timing smoke: with fetch and assemble each
+    costing ~delay per batch, the single-stager path pays fetch+assemble
+    serially (~2·delay/batch) while overlap pipelines them (~delay/batch
+    steady-state). Generous margins keep this robust to scheduler noise:
+    the overlapped wall must land below 0.75× the serial wall."""
+    delay, n = 0.04, 6
+
+    class Sleepy:
+        def __iter__(self):
+            for i in range(n):
+                time.sleep(delay)
+                yield (np.full((8, 4, 4, 3), i, np.float32),
+                       np.full((8,), i, np.int32))
+
+    def assemble(i, hb):
+        time.sleep(delay)
+        return hb
+
+    t0 = time.perf_counter()
+    serial = [b for b in DevicePrefetcher(Sleepy(), depth=2,
+                                          assemble=assemble)]
+    t_serial = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    overlapped = [b for b in DevicePrefetcher(Sleepy(), depth=2,
+                                              assemble=assemble,
+                                              overlap=True)]
+    t_overlap = time.perf_counter() - t0
+    assert len(serial) == len(overlapped) == n
+    # serial ≈ n·2·delay = 480 ms; overlap ≈ (n+1)·delay = 280 ms
+    assert t_overlap < 0.75 * t_serial, (t_overlap, t_serial)
+
+
+def test_overlap_exception_mid_transfer_joins_both_threads():
+    """Satellite fix: an assemble failure mid-pipeline must surface at the
+    iteration site AND leave neither the fetcher nor the h2d-stager
+    running — an orphaned H2D thread would race the sentinel's rc-8
+    drain (or a supervise.sh restart) for device memory."""
+
+    def explode(i, hb):
+        if i == 2:
+            raise ValueError("bad transfer")
+        return hb
+
+    pre = DevicePrefetcher(_loader(), depth=2, assemble=explode,
+                           overlap=True)
+    with pytest.raises(ValueError, match="bad transfer"):
+        list(pre)
+    assert _gone("host-fetcher", "h2d-stager"), (
+        "overlap pipeline thread still alive after assemble exception")
+    # the prefetcher stays reusable: a fresh pass re-raises, not hangs
+    with pytest.raises(ValueError, match="bad transfer"):
+        list(pre)
+
+
+def test_overlap_early_break_joins_threads_mid_transfer():
+    """Generator close (the trainer loops' try/finally, a SIGTERM unwind)
+    while a transfer is IN FLIGHT must drain and join both pipeline
+    threads, then support a fresh full pass."""
+
+    def slow_assemble(i, hb):
+        time.sleep(0.1)
+        return hb
+
+    pre = DevicePrefetcher(_loader(n=64, batch=8), depth=1,
+                           assemble=slow_assemble, overlap=True)
+    for i, _ in enumerate(pre):
+        if i == 1:
+            break  # batch 3's transfer is mid-flight on the h2d-stager
+    assert _gone("host-fetcher", "h2d-stager"), (
+        "overlap pipeline thread still alive after abandoned iteration")
+    assert len(list(pre)) == 8
+
+
 # ---------------------------------------------------------------- trainer --
 
 def _tiny_cfg(prefetch_depth):

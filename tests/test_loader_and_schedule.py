@@ -183,6 +183,56 @@ def test_warmup_rescales_under_grad_accum():
     assert float(sched(5)) == pytest.approx(1.0)
 
 
+def test_lr_trace_identical_across_grad_accum():
+    """LR-schedule semantics under accumulation: K=4 and K=1 runs with the
+    SAME optimizer-step budget produce IDENTICAL LR traces. grad_accum
+    slices microbatches out of one loader batch inside the jitted step, so
+    steps_per_epoch already counts optimizer steps and milestones need no
+    rescaling; only warmup_iters (reference semantics: microbatch
+    ITERATIONS) converts ÷K — equal optimizer-step warmups (K=4 ×
+    warmup 20 vs K=1 × warmup 5) must then trace identically everywhere.
+    A reintroduced per-microbatch schedule step (the classic off-by-K
+    accumulation bug) shifts every milestone by K× and fails here."""
+    base = dict(lr=1.0, schedule="multistep", milestones=(2, 4), gamma=0.1,
+                warmup_start_lr=0.0)
+    k4 = build_schedule(OptimConfig(warmup_iters=20, **base),
+                        steps_per_epoch=10, grad_accum=4)
+    k1 = build_schedule(OptimConfig(warmup_iters=5, **base),
+                        steps_per_epoch=10, grad_accum=1)
+    trace4 = [float(k4(s)) for s in range(50)]
+    trace1 = [float(k1(s)) for s in range(50)]
+    assert trace4 == pytest.approx(trace1)
+    # and the trace is the REAL one: warmup ramp then on-time milestones
+    assert trace4[2] == pytest.approx(0.4)
+    assert trace4[20] == pytest.approx(0.1)
+    assert trace4[40] == pytest.approx(0.01)
+
+
+def test_optimizer_applies_schedule_once_per_update_under_grad_accum():
+    """The accumulated step hands build_optimizer ONE summed/meaned
+    gradient per loader batch — every tx.update IS an optimizer step. A
+    resurrected optax.MultiSteps wrapper (which would treat each update
+    as a microbatch and only apply every K-th) shifts the whole decay
+    trace and fails here."""
+    import jax.numpy as jnp
+
+    from ddp_classification_pytorch_tpu.train.schedule import build_optimizer
+
+    params = {"w": jnp.ones((3,))}
+    grads = {"w": jnp.ones((3,))}
+    cfg = OptimConfig(optimizer="sgd", momentum=0.0, lr=1.0,
+                      schedule="multistep", milestones=(1,), gamma=0.1)
+    tx = build_optimizer(cfg, steps_per_epoch=2, grad_accum=4)
+    opt_state = tx.init(params)
+    mags = []
+    for _ in range(4):
+        updates, opt_state = tx.update(grads, opt_state, params)
+        mags.append(float(-updates["w"][0]))
+    # milestone (epoch 1 = optimizer step 2) lands after two UPDATES,
+    # exactly as in a grad_accum=1 run
+    assert mags == pytest.approx([1.0, 1.0, 0.1, 0.1])
+
+
 def test_head_param_group_hyperparams():
     # The reference's single optimizer spans TWO param groups (backbone, ARC
     # margin head — arc_main.py:248-253). head_lr/head_weight_decay diverge

@@ -221,6 +221,73 @@ def test_parse_fixture_trace():
             s["step_ms"])
 
 
+def _x(name, ts_us, dur_us, tid=0):
+    return {"ph": "X", "name": name, "pid": 1, "tid": tid,
+            "ts": float(ts_us), "dur": float(dur_us)}
+
+
+def test_parse_accum_window_buckets_and_amortization():
+    """Scanned gradient accumulation: ONE StepTraceAnnotation window (one
+    optimizer step) containing K=4 microbatch fwd/bwd executions and a
+    single deferred all-reduce. The per-lane union must sum the K disjoint
+    same-lane spans (and union a nested one) with the six buckets still
+    covering the wall time exactly; the collective lane carries ONE
+    reduction's time per window — the same absolute payload as a K=1
+    window but ÷K per microbatch, so its share of the wall shrinks vs the
+    K=1 fixture below."""
+    # K=1 reference: 4 optimizer steps, each its own 10 ms window with its
+    # own 2 ms gradient all-reduce (the per-step reduction being amortized)
+    k1_events = []
+    for n in range(4):
+        base = n * 11_000.0  # 10 ms window + 1 ms gap
+        k1_events += [
+            {**_x("bench_step", base, 10_000.0),
+             "args": {"step_num": n}},
+            _x("forward/block", base, 3_000.0),
+            _x("transpose(dot.1)", base + 3_000, 3_000.0),
+            _x("all-reduce.1", base + 6_000, 2_000.0),
+            _x("optimizer/sgd", base + 8_000, 1_000.0),
+        ]
+    k1 = tracelib.parse_chrome_trace({"traceEvents": k1_events})
+    assert len(k1) == 4
+
+    # K=4 accumulated step: one 40 ms window, 4 scanned microbatches on
+    # the same lane, ONE deferred all-reduce at the optimizer boundary
+    ev = [{**_x("bench_step", 0.0, 40_000.0), "args": {"step_num": 0}}]
+    for mb in range(4):
+        base = mb * 6_500.0
+        ev.append(_x("forward/block", base, 3_000.0))
+        ev.append(_x("transpose(dot.1)", base + 3_000, 3_000.0))
+    # a fusion nested inside microbatch 0's fwd span, same lane: must
+    # union into the covering span, not double-count
+    ev.append(_x("forward/stem_fusion", 500.0, 1_000.0))
+    ev.append(_x("all-reduce.1", 26_000.0, 2_000.0))
+    ev.append(_x("optimizer/sgd", 28_000.0, 1_000.0))
+    (acc,) = tracelib.parse_chrome_trace({"traceEvents": ev})
+
+    assert acc["step_ms"] == pytest.approx(40.0)
+    # 4 disjoint 3 ms fwd spans; the nested fusion unions away
+    assert acc["fwd"] == pytest.approx(12.0)
+    assert acc["bwd"] == pytest.approx(12.0)
+    assert acc["optimizer"] == pytest.approx(1.0)
+    # exactly ONE reduction's microseconds in the whole optimizer step —
+    # equal to a single K=1 window's collective time (payload parity)...
+    assert acc["collectives"] == pytest.approx(k1[0]["collectives"])
+    # ...so the collective share of the wall is ~K× smaller than K=1
+    k1_share = sum(s["collectives"] for s in k1) / sum(
+        s["step_ms"] for s in k1)
+    acc_share = acc["collectives"] / acc["step_ms"]
+    assert acc_share < k1_share / 3.5
+    # the invariant the whole breakdown hangs on: buckets sum to the wall
+    # time exactly, idle the remainder — even with K scanned microbatches
+    # inside one window
+    assert sum(acc[b] for b in tracelib.BUCKETS) == pytest.approx(
+        acc["step_ms"])
+    for s in k1:
+        assert sum(s[b] for b in tracelib.BUCKETS) == pytest.approx(
+            s["step_ms"])
+
+
 def test_aggregate_means_and_empty():
     with open(FIXTURE) as f:
         agg = tracelib.aggregate(tracelib.parse_chrome_trace(json.load(f)))

@@ -204,6 +204,42 @@ def test_zero_opt_knobs_locked_in_both_entrypoints():
     assert '"float32", "bfloat16"' in src
 
 
+def test_grad_accum_h2d_knobs_locked_in_both_entrypoints():
+    """The grad-accum / H2D-overlap knobs must stay addressable from both
+    entrypoints: cli.train (underscore `--grad_accum`, dashed
+    `--h2d-overlap`; feed cfg.parallel/cfg.data) and bench.py (dashed
+    spellings; feed the e2e row's grad_accum /
+    collective_bytes_per_optimizer_step / h2d_overlap evidence). Same
+    drift guard as the ZeRO knobs above."""
+    from ddp_classification_pytorch_tpu.cli.train import build_parser
+
+    known = set()
+    actions = {}
+    for action in build_parser()._actions:
+        known.update(action.option_strings)
+        for s in action.option_strings:
+            actions[s] = action
+    assert "--grad_accum" in known, "cli.train lost --grad_accum"
+    assert actions["--grad_accum"].type is int
+    assert "--h2d-overlap" in known, "cli.train lost --h2d-overlap"
+    with open(os.path.join(REPO, "bench.py")) as f:
+        src = f.read()
+    assert '"--grad-accum"' in src, "bench.py lost --grad-accum"
+    assert '"--h2d-overlap"' in src, "bench.py lost --h2d-overlap"
+
+
+def test_worklist_captures_grad_accum_comms_ab():
+    """The owed-work list must keep the K∈{1,4} × wire {f32,bf16} comms
+    A/B corners (plus the overlap evidence riding the K=4 rows) — a
+    silently dropped corner un-proves the ÷K/÷2K amortization claim on
+    the next window."""
+    body = _script_body("tpu_up_worklist.sh")
+    for needle in ("--grad-accum 4", "--grad-reduce-dtype bfloat16",
+                   "--h2d-overlap", "accum4_bf16:", "accum1_bf16:",
+                   "accum4_f32:"):
+        assert needle in body, f"worklist lost its {needle!r} A/B piece"
+
+
 def test_worklist_bench_step_captures_serve_row():
     """The owed-work list must keep running bench with ALL evidence rows:
     --e2e (uint8 wire), --serve (serve_latency) and --trace (the on-device
