@@ -673,6 +673,119 @@ def _serve_metric_name(arch: str, on_accel: bool, platform: str) -> str:
             + ("" if on_accel else f"_{platform}"))
 
 
+def _serve_slo_metric_name(arch: str, on_accel: bool, platform: str) -> str:
+    """JSON metric name for the SLO-search row (max sustainable offered
+    rps at a p99 latency SLO) — locked by tests/test_bench_meta.py."""
+    return (f"{arch}_max_rps_at_p99_slo"
+            + ("" if on_accel else f"_{platform}"))
+
+
+def _bench_serve_slo_row(cfg, mesh, *, metric: str, slo_p99_ms: float,
+                         max_rps: float, iters: int, n_requests: int,
+                         buckets, max_batch: int, timeout_ms: float,
+                         topk: int, seed: int = 0):
+    """Closed-loop offered-load search: the max sustainable requests/s at
+    a p99 latency SLO, on ONE warm `ServingEngine` (every bucket compiled
+    before the first probe, so no probe pays a compile).
+
+    Each probe paces `n_requests` submissions on the ideal schedule for a
+    candidate offered rps and measures the end-to-end p99 (submit → top-k
+    answer) from the returned predictions themselves — a fresh sample per
+    probe, not the engine's cumulative window. The search is a bisection
+    over [0, max_rps]: a probe holding the SLO raises the floor, a breach
+    lowers the ceiling; the reported value is the highest KNOWN-GOOD rps
+    (the floor), never an extrapolation. The probe ladder rides along in
+    the row so a regression is diagnosable from the JSON alone
+    (docs/serving.md "SLO search")."""
+    import tempfile
+
+    import numpy as np
+
+    from ddp_classification_pytorch_tpu.config import dp_round_up_buckets
+    from ddp_classification_pytorch_tpu.parallel.mesh import DATA_AXIS
+    from ddp_classification_pytorch_tpu.serve.engine import ServingEngine
+    from ddp_classification_pytorch_tpu.serve.metrics import (
+        ServeMetrics,
+        percentile,
+    )
+    from ddp_classification_pytorch_tpu.train.state import create_train_state
+    from ddp_classification_pytorch_tpu.train.steps import make_topk_predict_step
+
+    with mesh, tempfile.TemporaryDirectory() as tmp:
+        dp = int(dict(mesh.shape).get(DATA_AXIS, 1))
+        buckets = dp_round_up_buckets(buckets, dp)
+        model, _, state = create_train_state(cfg, mesh, steps_per_epoch=100)
+        predict = make_topk_predict_step(cfg, model, topk, mesh=mesh)
+        engine = ServingEngine(
+            state, predict,
+            image_size=cfg.data.image_size,
+            input_dtype=cfg.data.input_dtype,
+            max_batch=max_batch, batch_timeout_ms=timeout_ms,
+            queue_depth=max(n_requests, 64), buckets=buckets,
+            metrics=ServeMetrics(latency_window=max(n_requests, 2048)),
+            mesh=mesh, aot_dir=os.path.join(tmp, "aot"))
+        engine.warmup()
+        engine.start()
+        rng = np.random.default_rng(seed)
+        h = cfg.data.image_size
+        n_distinct = min(n_requests, 16)
+        pool = (rng.integers(0, 256, (n_distinct, h, h, 3)).astype(np.uint8)
+                if cfg.data.input_dtype == "uint8"
+                else rng.normal(size=(n_distinct, h, h, 3)).astype(np.float32))
+
+        def probe_p99(rps: float) -> float:
+            t0 = time.perf_counter()
+            futures = []
+            for i in range(n_requests):
+                lag = t0 + i / rps - time.perf_counter()
+                if lag > 0:
+                    time.sleep(lag)
+                futures.append(engine.submit(pool[i % n_distinct]))
+            lats = sorted(f.result(timeout=120).latency_ms for f in futures)
+            return percentile(lats, 99)
+
+        probes = []
+        lo, lo_p99 = 0.0, 0.0
+        hi = float(max_rps)
+        # ceiling probe first: if even max_rps holds the SLO there is
+        # nothing to bisect — the bound, not the engine, is the limit
+        p99 = probe_p99(hi)
+        probes.append({"rps": round(hi, 2), "p99_ms": round(p99, 3),
+                       "ok": p99 <= slo_p99_ms})
+        if p99 <= slo_p99_ms:
+            lo, lo_p99 = hi, p99
+        else:
+            for _ in range(max(int(iters), 1)):
+                mid = (lo + hi) / 2.0
+                p99 = probe_p99(mid)
+                ok = p99 <= slo_p99_ms
+                probes.append({"rps": round(mid, 2),
+                               "p99_ms": round(p99, 3), "ok": ok})
+                if ok:
+                    lo, lo_p99 = mid, p99
+                else:
+                    hi = mid
+        engine.drain()
+
+    return {
+        "metric": metric,
+        "unit": "rps",
+        "value": round(lo, 2),
+        "p99_slo_ms": slo_p99_ms,
+        "p99_at_max_ms": round(lo_p99, 3),
+        "slo_bound_rps": float(max_rps),
+        "bound_limited": bool(probes[0]["ok"]),
+        "iterations": len(probes),
+        "n_requests_per_probe": n_requests,
+        "probes": probes,
+        "topk": topk,
+        "max_batch": max_batch,
+        "batch_timeout_ms": timeout_ms,
+        "buckets": list(buckets),
+        "serve_devices": int(engine.serve_devices),
+    }
+
+
 def _bench_serve_row(cfg, mesh, *, metric: str, n_requests: int,
                      offered_rps: float, buckets, max_batch: int,
                      timeout_ms: float, topk: int, seed: int = 0):
@@ -910,6 +1023,18 @@ def main() -> None:
                     help="deadline batcher's largest micro-batch for --serve")
     ap.add_argument("--serve-timeout-ms", type=float, default=5.0,
                     help="partial-batch flush deadline for --serve")
+    ap.add_argument("--serve-slo-p99-ms", type=float, default=0.0,
+                    help="with --serve: also run the closed-loop offered-"
+                         "load search for the max sustainable rps whose "
+                         "measured p99 stays under this SLO, emitted as an "
+                         "<arch>_max_rps_at_p99_slo extra row (0 = off)")
+    ap.add_argument("--serve-slo-max-rps", type=float, default=512.0,
+                    help="upper bound of the SLO search's bisection over "
+                         "offered rps (the ceiling probe runs first; if it "
+                         "holds the SLO the row reports bound_limited)")
+    ap.add_argument("--serve-slo-iters", type=int, default=6,
+                    help="bisection iterations for the SLO search (each "
+                         "probe pushes --serve-requests paced submissions)")
     args = ap.parse_args()
 
     def remaining() -> float:
@@ -1163,6 +1288,47 @@ def main() -> None:
                       f"{row['bucket_hist']}", file=sys.stderr)
             except Exception as e:  # serve must not cost the flagship line
                 print(f"# serve row failed: {type(e).__name__}: {e}",
+                      file=sys.stderr)
+
+    if args.serve and args.serve_slo_p99_ms > 0:
+        # the search is a ladder of paced load runs on one warm engine:
+        # budget it like the serve row plus one run per bisection step
+        slo_budget = 180.0 + 10.0 * max(args.serve_slo_iters, 1)
+        if remaining() < slo_budget:
+            print(f"# skipping SLO search row: {remaining():.0f}s left "
+                  f"< {slo_budget:.0f}s budget", file=sys.stderr)
+        elif args.serve_slo_max_rps <= 0:
+            print("# skipping SLO search row: --serve-slo-max-rps must be "
+                  "> 0", file=sys.stderr)
+        else:
+            try:
+                scfg = get_preset("baseline")
+                scfg.model.arch = args.arch
+                scfg.model.dtype = cfg.model.dtype
+                scfg.data.num_classes = 1000
+                scfg.data.image_size = cfg.data.image_size
+                buckets = tuple(int(b) for b in args.serve_buckets.split(",") if b)
+                n_req = args.serve_requests if on_accel else min(
+                    args.serve_requests, 24)
+                row = _bench_serve_slo_row(
+                    scfg, mesh,
+                    metric=_serve_slo_metric_name(args.arch, on_accel,
+                                                  platform),
+                    slo_p99_ms=args.serve_slo_p99_ms,
+                    max_rps=args.serve_slo_max_rps,
+                    iters=args.serve_slo_iters,
+                    n_requests=n_req, buckets=buckets,
+                    max_batch=args.serve_max_batch,
+                    timeout_ms=args.serve_timeout_ms, topk=5)
+                extra.append(row)
+                partial_box["row"] = dict(partial_box["row"], extra=list(extra))
+                print(f"# SLO search row: {row['value']} rps sustains "
+                      f"p99 <= {row['p99_slo_ms']}ms "
+                      f"(measured {row['p99_at_max_ms']}ms, "
+                      f"{row['iterations']} probes, bound_limited="
+                      f"{row['bound_limited']})", file=sys.stderr)
+            except Exception as e:  # the search must not cost the flagship line
+                print(f"# SLO search row failed: {type(e).__name__}: {e}",
                       file=sys.stderr)
 
     if probe:

@@ -558,6 +558,22 @@ def _build_topk_predict_serve_dp_tp(ctx: AuditContext):
     return make_topk_predict_step(cfg, model, k=3, mesh=mesh), args
 
 
+def _build_topk_predict_serve_fleet(ctx: AuditContext):
+    """The serve-FLEET predict: the same mesh-pinned serve program at the
+    dp4 width a small autoscaled replica runs (serve_mesh over 4 devices).
+    The data axis is the only axis, but at width 4 the per-shard batch is
+    a quarter of the bucket — so the banked program proves the dp-split
+    top-k stays collective-free at the fleet's provisioning unit, not
+    just at the dp2 audit minimum."""
+    from ..train.steps import make_topk_predict_step
+
+    mesh = ctx.composed_mesh("dp4")
+    cfg, model, _, state = ctx.state_for("baseline")
+    fn = make_topk_predict_step(cfg, model, k=3, mesh=mesh)
+    return fn, (abstract_state(state, mesh),
+                batch_sharded(ctx.images(), mesh))
+
+
 def _build_train_bf16_reduce(ctx: AuditContext):
     """The bf16-wire gradient-reduction variant of the train step
     (parallel.grad_reduce_dtype=bfloat16): a shard_map fwd/bwd whose
@@ -689,6 +705,13 @@ def build_registry() -> List[StepSpec]:
             name="topk_predict_serve_dp_tp",
             factory="ddp_classification_pytorch_tpu.train.steps:make_topk_predict_step",
             build=_build_topk_predict_serve_dp_tp,
+            no_donate_reason=_EVAL_NO_DONATE,
+            uint8_input=True,
+        ),
+        StepSpec(
+            name="topk_predict_serve_fleet",
+            factory="ddp_classification_pytorch_tpu.train.steps:make_topk_predict_step",
+            build=_build_topk_predict_serve_fleet,
             no_donate_reason=_EVAL_NO_DONATE,
             uint8_input=True,
         ),

@@ -692,7 +692,7 @@ class ShardedCase:
     sub-f32 wire dtype beyond it is a `dtype-wire` finding (D5)."""
 
     name: str          # registry program name
-    mesh_name: str     # composed_audit_meshes key: 'dp2' | 'dp2tp2'
+    mesh_name: str     # composed_audit_meshes key: 'dp2' | 'dp2tp2' | 'dp4'
     build: Callable[[AuditContext, Any], Tuple[Any, Tuple[Any, ...]]]
     policy: CommsPolicy
     donate: Tuple[int, ...] = ()
@@ -855,6 +855,11 @@ def sharded_registry() -> List[ShardedCase]:
         ShardedCase("topk_predict_serve_dp", "dp2",
                     _case_topk_predict_serve, EVAL_COMMS),
         ShardedCase("topk_predict_serve_dp_tp", "dp2tp2",
+                    _case_topk_predict_serve, EVAL_COMMS),
+        # the serve-FLEET cell: the same serve program at the dp4 width an
+        # autoscaled replica provisions — banked so --diff-baseline fences
+        # the fleet hot path's comms/HBM at its own data-axis width
+        ShardedCase("topk_predict_serve_fleet", "dp4",
                     _case_topk_predict_serve, EVAL_COMMS),
         ShardedCase("eval_step", "dp2", _case_eval, EVAL_COMMS),
         ShardedCase("eval_step", "dp2tp2", _case_eval, EVAL_COMMS),

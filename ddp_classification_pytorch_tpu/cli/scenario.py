@@ -5,9 +5,11 @@
         --scenario_spec scenario.json --out runs/scenario
 
 Launches an elastic trainer pod publishing checkpoints into a shared run
-dir while serve replicas sustain offered load, drives the chaos timeline
-from the spec, then replays the recorded `events.jsonl` through the S1–S4
-invariant checkers. `--check_only` skips the run and re-checks an existing
+dir while serve replicas (fleet members sharing leases and the rolling
+drain token) sustain offered load, drives the chaos timeline from the spec
+(including `spike_load` offered-load steps and autoscaling when the spec
+arms `serve.max_replicas`), then replays the recorded `events.jsonl`
+through the S1–S5 invariant checkers. `--check_only` skips the run and re-checks an existing
 events file (post-mortem of a red run, and how the synthetic-timeline tests
 prove each checker fires).
 
@@ -103,7 +105,8 @@ def main(argv: Optional[Sequence[str]] = None) -> None:
         raise SystemExit(1)
     print("[scenario] GREEN: S1 verified-serve, S2 availability floor, "
           "S3 bounded adoption"
-          + ("" if args.skip_lint else ", S4 analyzer gate") + " all held")
+          + ("" if args.skip_lint else ", S4 analyzer gate")
+          + ", S5 fleet all held")
 
 
 if __name__ == "__main__":

@@ -98,6 +98,34 @@ def build_parser() -> argparse.ArgumentParser:
                         "compiled bucket programs in <ckpt dir>/aot so the "
                         "next replica boots without compiling, 'off' "
                         "disables, else an explicit sidecar dir")
+    s.add_argument("--fleet_dir", "--fleet-dir", dest="fleet_dir", default="",
+                   help="shared fleet run dir: replicas heartbeat via "
+                        "<dir>/serve_fleet/lease.r<id> and serialize hot "
+                        "reloads through one drain token (rolling wave, at "
+                        "most one replica draining); default: lone replica")
+    s.add_argument("--fleet_replica", "--fleet-replica", dest="fleet_replica",
+                   type=int, default=-1,
+                   help="this replica's id in the shared --fleet_dir "
+                        "(lowest live id is the leader; default 0)")
+    s.add_argument("--fleet_ttl_s", "--fleet-ttl-s", dest="fleet_ttl_s",
+                   type=float, default=-1.0,
+                   help="lease/drain-token freshness horizon: a lease older "
+                        "than this is a dead replica, a stale token is "
+                        "taken over so a kill mid-wave cannot wedge the "
+                        "wave (default 15)")
+    s.add_argument("--admission_deadline_ms", "--admission-deadline-ms",
+                   dest="admission_deadline_ms", type=float, default=-1.0,
+                   help=">0: shed requests when the MEASURED queue wait "
+                        "(depth / observed service rate) exceeds this "
+                        "deadline — fair-share tenants shed at 1x, any "
+                        "tenant at 2x; 503 bodies carry the depth + shed "
+                        "tenant (default 0 = engine queue bound only)")
+    s.add_argument("--admission_tenants", "--admission-tenants",
+                   dest="admission_tenants", default="",
+                   help="per-tenant weighted fair shares for admission, "
+                        "'name:weight,name:weight' (requests pick a tenant "
+                        "via the X-Tenant header; default: one 'default' "
+                        "tenant at weight 1)")
     s.add_argument("--strict_compile", action="store_true",
                    help="make a steady-state recompile fatal (rc 2): warmup "
                         "prepays exactly len(buckets) programs and arms a "
@@ -164,11 +192,22 @@ def config_from_args(args: argparse.Namespace) -> Config:
         sv.serve_devices = args.serve_devices
     if args.aot_cache:
         sv.aot_cache = args.aot_cache
+    if args.fleet_dir:
+        sv.fleet_dir = args.fleet_dir
+    if args.fleet_replica >= 0:
+        sv.fleet_replica = args.fleet_replica
+    if args.fleet_ttl_s >= 0:
+        sv.fleet_ttl_s = args.fleet_ttl_s
+    if args.admission_deadline_ms >= 0:
+        sv.admission_deadline_ms = args.admission_deadline_ms
+    if args.admission_tenants:
+        sv.admission_tenants = args.admission_tenants
 
     # dp divisibility re-resolves against the real mesh width in main()
     # (inside the same rc-2 net); this catches the dp-independent errors
     # before any backend work
     sv.resolve_buckets()  # raises ValueError on bad knob combinations
+    sv.validate_fleet()  # fleet/admission knobs are config-shaped too
     if sv.topk > cfg.data.num_classes:
         raise ValueError(
             f"serve.topk={sv.topk} exceeds num_classes={cfg.data.num_classes}")
@@ -301,6 +340,24 @@ def main(argv: Optional[Sequence[str]] = None) -> None:
                                        transform=transform,
                                        mesh=mesh, aot_dir=aot_dir)
 
+    fleet = None
+    if cfg.serve.fleet_dir:
+        from ..serve.fleet import FleetMember
+
+        # shares the engine registry so fleet_* gauges ride /metrics; the
+        # lease heartbeat itself piggybacks on the watcher poll tick
+        fleet = FleetMember(cfg.serve.fleet_dir, cfg.serve.fleet_replica,
+                            ttl_s=cfg.serve.fleet_ttl_s,
+                            registry=metrics.registry)
+    admission = None
+    if cfg.serve.admission_deadline_ms > 0:
+        from ..serve.fleet import AdmissionController
+
+        admission = AdmissionController(
+            engine, tenants=cfg.serve.admission_tenants,
+            deadline_ms=cfg.serve.admission_deadline_ms,
+            registry=metrics.registry)
+
     watcher = None
     if cfg.serve.watch_dir:
         from ..utils import chaos as chaoslib
@@ -312,7 +369,8 @@ def main(argv: Optional[Sequence[str]] = None) -> None:
         watcher = CheckpointWatcher(cfg.serve.watch_dir, engine, state,
                                     poll_s=cfg.serve.reload_poll_s,
                                     metrics=metrics,
-                                    chaos=plan if plan else None)
+                                    chaos=plan if plan else None,
+                                    fleet=fleet)
         loaded = watcher.restore_initial()
         host0_print(f"[serve] watching {cfg.serve.watch_dir} "
                     + (f"(serving epoch {loaded})" if loaded >= 0 else
@@ -351,6 +409,8 @@ def main(argv: Optional[Sequence[str]] = None) -> None:
         engine.drain()
         if watcher is not None:
             watcher.stop()
+        if fleet is not None:
+            fleet.leave()
         host0_print(metrics.log_line(engine.queue_depth))
         if tb is not None:
             metrics.to_tensorboard(tb, 0)
@@ -375,9 +435,15 @@ def main(argv: Optional[Sequence[str]] = None) -> None:
     if cfg.serve.port:
         from ..serve.http import start_server
 
-        server = start_server(engine, cfg.serve.port, watcher=watcher)
+        server = start_server(engine, cfg.serve.port, watcher=watcher,
+                              fleet=fleet, admission=admission)
         host0_print(f"[serve] http on :{cfg.serve.port} "
                     "(POST /predict, GET /healthz, GET /metrics)")
+    if fleet is not None and watcher is None:
+        # --ckpt pins the params (no watcher poll to ride): announce the
+        # pinned digest once so the registry sees this replica at all
+        fleet.heartbeat(digest=engine.params_digest,
+                        generation=engine.params_generation)
     from ..obs.events import emit
 
     emit("serve_ready", port=cfg.serve.port,
@@ -403,6 +469,8 @@ def main(argv: Optional[Sequence[str]] = None) -> None:
     if watcher is not None:
         watcher.stop()
     engine.drain()
+    if fleet is not None:
+        fleet.leave()  # drop the lease now, not after the TTL
     emit("drain_end")
     host0_print(metrics.log_line(engine.queue_depth))
     if tb is not None:

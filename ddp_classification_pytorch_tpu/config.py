@@ -363,6 +363,36 @@ class ServeConfig:
     # bucket padding) is counted + logged. False = warn-only; True = the
     # engine stops intake and cli.serve exits rc 2 (deterministic).
     strict_compile: bool = False
+    # --- serve-fleet control plane (serve/fleet.py) ---
+    # shared fleet run dir ("" = fleet off, lone-replica mode). Replicas
+    # sharing it heartbeat via $FLEET_DIR/serve_fleet/lease.r<id> and
+    # serialize hot reloads through the single drain token (rolling wave).
+    fleet_dir: str = ""
+    fleet_replica: int = 0  # this replica's id in the shared fleet dir
+    fleet_ttl_s: float = 15.0  # lease/token freshness horizon (mtime vs now)
+    # admission control above the engine queue: 0 = off (engine bound only);
+    # >0 = shed when measured wait (depth / observed service rate) exceeds
+    # this deadline (fair-share shed at 1x, any-tenant shed at 2x)
+    admission_deadline_ms: float = 0.0
+    # per-tenant weighted fair shares, "name:weight,name:weight"
+    # ("" = single 'default' tenant at weight 1)
+    admission_tenants: str = ""
+
+    def validate_fleet(self) -> None:
+        """Config-shaped fleet/admission validation (ValueError = rc 2)."""
+        if self.fleet_replica < 0:
+            raise ValueError(
+                f"serve.fleet_replica must be >= 0, got {self.fleet_replica}")
+        if self.fleet_ttl_s <= 0:
+            raise ValueError(
+                f"serve.fleet_ttl_s must be > 0, got {self.fleet_ttl_s}")
+        if self.admission_deadline_ms < 0:
+            raise ValueError(
+                f"serve.admission_deadline_ms must be >= 0, "
+                f"got {self.admission_deadline_ms}")
+        from .serve.fleet import parse_tenants
+
+        parse_tenants(self.admission_tenants)
 
     def resolve_buckets(self, dp: int = 1) -> tuple:
         """Validated ascending bucket tuple (ValueError = config-shaped,

@@ -36,6 +36,27 @@ Event vocabulary (fields beyond ts/kind/source):
     lint           rc                                end-of-run analyzer gate
     scenario_start / scenario_end                    supervisor brackets
 
+Serve-fleet control plane (serve/fleet.py + supervisor autoscaling; the
+S5 invariant replays these):
+
+    drain_token_acquire   replica, digest            wave slot taken — this
+                                                     replica is draining
+    drain_token_release   replica, digest,           wave slot freed post-swap
+                          generation
+    drain_token_takeover  replica, stale_holder?     TTL-stale token replaced
+                                                     (wedged holder evicted)
+    admission_shed        tenant, queue_depth,       admission layer refused a
+                          est_wait_ms                request (503 forensics)
+    spike_load            rps                        supervisor stepped the
+                                                     offered load
+    scale_out             replica, replicas,         autoscaler added a replica
+                          queue_depth, p99_ms,
+                          offered_rps
+    scale_in              replica, replicas,         autoscaler retiring one
+                          queue_depth, fill_ratio
+    replica_retire        replica                    retired replica excused
+                                                     from future S3 adoption
+
 Historically this lived at `scenario/events.py`; it was promoted here so
 non-scenario subsystems emit through the same spine without reaching into
 the scenario package. `scenario.events` remains a compat re-export.

@@ -97,7 +97,9 @@ def make_mesh(spec: MeshSpec = MeshSpec(), devices: Optional[Sequence[Any]] = No
 def composed_audit_meshes(devices: Optional[Sequence[Any]] = None
                           ) -> "dict[str, Mesh]":
     """The analysis passes' composed multi-device meshes, by name:
-    `dp2` (2×1, data-only) and `dp2tp2` (2×2, dp×tp), built over a
+    `dp2` (2×1, data-only), `dp2tp2` (2×2, dp×tp), and `dp4` (4×1, the
+    serve-fleet width: one data axis wide enough that the dp-split top-k
+    gather is non-trivial), built over a
     deterministic PREFIX of the device list so the audited program — and
     therefore the checked-in baseline (analysis/baselines.json) — is
     identical whether the host exposes 4, 8, or 256 devices. Meshes the
@@ -110,6 +112,7 @@ def composed_audit_meshes(devices: Optional[Sequence[Any]] = None
         out["dp2"] = make_mesh(MeshSpec(2, 1), devices=devices[:2])
     if len(devices) >= 4:
         out["dp2tp2"] = make_mesh(MeshSpec(2, 2), devices=devices[:4])
+        out["dp4"] = make_mesh(MeshSpec(4, 1), devices=devices[:4])
     return out
 
 

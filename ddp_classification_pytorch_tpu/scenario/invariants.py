@@ -1,4 +1,4 @@
-"""S1–S4 invariant checkers over a parsed scenario event timeline.
+"""S1–S5 invariant checkers over a parsed scenario event timeline.
 
 The contracts (docs/operations.md has the operator-facing wording):
 
@@ -27,6 +27,21 @@ The contracts (docs/operations.md has the operator-facing wording):
 - **S4 analyzer gate** — the run must end with a ``lint`` event of
   rc 0: `cli.analyze --diff-baseline` + lint.sh still green after the
   whole drill (no program drift, no rc-discipline regressions).
+- **S5 fleet** — the serve-fleet control plane held shape under load:
+  (a) *rolling wave exclusivity*: replaying the
+  ``drain_token_acquire``/``release``/``takeover`` stream, at most one
+  replica holds the drain token — i.e. is draining — at any instant (a
+  ``takeover`` force-closes the wedged holder's interval, exactly the
+  last-writer-wins semantics of the token file); (b) *digest
+  convergence*: every surviving (non-retired) replica's final ``swap``
+  lands on ONE digest, and it is the digest of the newest good publish;
+  (c) *scale-out deadline*: when the spec arms the autoscaler
+  (``max_replicas > replicas``), every ``spike_load`` must be answered
+  by a ``scale_out`` within ``scale_out_deadline_s``. A timeline with
+  no fleet events passes vacuously (pre-fleet runs stay checkable).
+  S3 composes with retirement: a ``replica_retire``\\ d replica is
+  excused from publishes whose adoption deadline falls after it left
+  (it will never swap again — that is the point of scale-in).
 
 Checkers only READ the timeline; they never mutate it. Each returns the
 violations it found, so `cli.scenario --check_only` can replay a saved
@@ -55,7 +70,7 @@ _RESTART_LINE_RE = re.compile(
 
 @dataclass
 class Violation:
-    invariant: str  # "S1" | "S2" | "S3" | "S4"
+    invariant: str  # "S1" | "S2" | "S3" | "S4" | "S5"
     message: str
 
     def __str__(self) -> str:
@@ -147,6 +162,17 @@ def good_publishes(events: Sequence[Dict]) -> List[Dict]:
             and e.get("path") not in quarantined]
 
 
+def replica_retire_times(events: Sequence[Dict]) -> Dict[str, float]:
+    """replica source -> ts of its LAST replica_retire (scale-in). The
+    supervisor emits these, so the replica name is in the `replica`
+    field, not `source`."""
+    out: Dict[str, float] = {}
+    for e in events:
+        if e.get("kind") == "replica_retire":
+            out[str(e.get("replica", ""))] = float(e.get("ts", 0.0))
+    return out
+
+
 def check_s3_adoption(events: Sequence[Dict],
                       spec: ScenarioSpec) -> List[Violation]:
     out: List[Violation] = []
@@ -160,6 +186,7 @@ def check_s3_adoption(events: Sequence[Dict],
     if not ready:
         return [Violation("S3", "no serve_ready events — no replica ever "
                                 "came up, adoption unproven")]
+    retired = replica_retire_times(events)
     swaps: Dict[str, List[Dict]] = {}
     for e in events:
         if e.get("kind") == "swap":
@@ -172,6 +199,13 @@ def check_s3_adoption(events: Sequence[Dict],
             # process cannot adopt earlier than its own warmup
             base = max([t_pub] + [t for t in ready_times if t >= t_pub])
             deadline = base + spec.adopt_deadline_s
+            retire_ts = retired.get(replica)
+            if retire_ts is not None and retire_ts <= deadline \
+                    and not any(t > retire_ts for t in ready_times):
+                # scale-in excusal: the replica left the fleet before its
+                # adoption deadline and never came back — it will never
+                # swap again, and that is the point of retirement
+                continue
             adopted = [s for s in swaps.get(replica, [])
                        if int(s.get("epoch", -1)) >= epoch
                        and float(s.get("ts", 0.0)) <= deadline]
@@ -211,6 +245,75 @@ def check_restarts_log(path: str) -> List[Violation]:
     return out
 
 
+def check_s5_fleet(events: Sequence[Dict],
+                   spec: ScenarioSpec) -> List[Violation]:
+    """Fleet control-plane contract: wave exclusivity, survivor digest
+    convergence, spike→scale-out deadline (see module docstring). A
+    timeline without fleet events passes vacuously."""
+    out: List[Violation] = []
+
+    # (a) rolling wave exclusivity — replay the token stream
+    holder: Optional[str] = None
+    for e in events:
+        kind = e.get("kind")
+        src = str(e.get("source", ""))
+        if kind == "drain_token_takeover":
+            # the new holder proved the old one stale (lease TTL) and
+            # atomically replaced the token: the wedged interval is over
+            holder = None
+        elif kind == "drain_token_acquire":
+            if holder is not None and holder != src:
+                out.append(Violation(
+                    "S5", f"two replicas draining at once: {src} acquired "
+                          f"the drain token at ts={e.get('ts')} while "
+                          f"{holder} still held it"))
+            holder = src
+        elif kind == "drain_token_release" and src == holder:
+            holder = None
+
+    # (b) survivor digest convergence — every non-retired replica's last
+    # swap must land on ONE digest: the newest good publish's
+    swaps: Dict[str, Dict] = {}
+    for e in events:
+        if e.get("kind") == "swap":
+            swaps[str(e.get("source", ""))] = e
+    retired = set(replica_retire_times(events))
+    finals = {src: str(e.get("digest", "")) for src, e in swaps.items()
+              if src not in retired}
+    if finals:
+        distinct = sorted(set(finals.values()))
+        if len(distinct) > 1:
+            out.append(Violation(
+                "S5", "fleet did not converge: surviving replicas ended on "
+                      f"{len(distinct)} digests "
+                      f"({ {s: d[:12] for s, d in sorted(finals.items())} })"))
+        goods = good_publishes(events)
+        if goods and len(distinct) == 1:
+            newest = max(goods, key=lambda e: int(e.get("epoch", -1)))
+            want = str(newest.get("digest", ""))
+            if want and distinct[0] != want:
+                out.append(Violation(
+                    "S5", f"fleet converged on digest {distinct[0][:12]}… "
+                          f"but the newest good publish (epoch "
+                          f"{newest.get('epoch')}) is {want[:12]}…"))
+
+    # (c) spike → scale-out deadline, only when the spec arms the scaler
+    if spec.serve.max_replicas > spec.serve.replicas:
+        scale_ts = [float(e.get("ts", 0.0)) for e in events
+                    if e.get("kind") == "scale_out"]
+        for e in events:
+            if e.get("kind") != "spike_load":
+                continue
+            t_spike = float(e.get("ts", 0.0))
+            limit = t_spike + spec.serve.scale_out_deadline_s
+            if not any(t_spike <= t <= limit for t in scale_ts):
+                out.append(Violation(
+                    "S5", f"spike_load at ts={t_spike:.1f} "
+                          f"(rps={e.get('rps')}) was never answered by a "
+                          f"scale_out within {spec.serve.scale_out_deadline_s}s"))
+    return out
+
+
 def check_s4_analyzer(events: Sequence[Dict]) -> List[Violation]:
     lints = [e for e in events if e.get("kind") == "lint"]
     if not lints:
@@ -234,4 +337,5 @@ def check_invariants(events: Sequence[Dict], spec: ScenarioSpec,
         out.extend(check_restarts_log(path))
     if require_lint:
         out.extend(check_s4_analyzer(events))
+    out.extend(check_s5_fleet(events, spec))
     return out

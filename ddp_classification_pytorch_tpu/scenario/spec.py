@@ -13,13 +13,17 @@ chaos scenario (docs/operations.md "Scenario drill" has the runbook).
       "serve": {
         "replicas": 2, "poll_s": 1.0, "queue_depth": 16,
         "max_batch": 4, "buckets": "1,4",
+        "max_replicas": 3, "fleet_ttl_s": 6.0,
+        "admission_deadline_ms": 0.0, "scale_out_deadline_s": 60.0,
         "fault_specs": {"0": "watcher_io@poll=3"}
       },
       "load": {"rps": 4.0, "timeout_s": 20.0},
       "availability": {"floor": 0.5, "window_s": 10.0, "min_samples": 3},
       "adopt_deadline_s": 120.0,
       "deadline_s": 600.0,
-      "timeline": [{"at": "publish:1", "action": "drain_replica", "replica": 1}]
+      "timeline": [{"at": "publish:1", "action": "drain_replica", "replica": 1},
+                   {"at": "t:30", "action": "spike_load", "rps": 12.0},
+                   {"at": "t:40", "action": "kill_replica_during_wave"}]
     }
 
 Per-host / per-replica `fault_specs` reuse the utils/chaos.py grammar
@@ -29,8 +33,22 @@ no ``CHAOS_HOST`` gating needed). The ``timeline`` drives the faults chaos
 cannot express in-process: supervisor-side actions fired at a wall-clock
 offset (``"t:SECONDS"``) or when the trainer publishes a given epoch
 (``"publish:EPOCH"``). Actions: ``drain_replica`` (SIGTERM → graceful
-drain → relaunch: the reload-during-drain window) and ``kill_replica``
-(SIGKILL → relaunch).
+drain → relaunch: the reload-during-drain window), ``kill_replica``
+(SIGKILL → relaunch), ``kill_replica_during_wave`` (SIGKILL the replica
+holding the fleet's drain token once a reload wave is in flight —
+targets the holder, so it takes no ``replica`` field; proves the
+lease-TTL token hand-off under the S5 invariant), and ``spike_load``
+(an offered-load step function: from the fire time on, the load
+generator sustains ``rps`` instead of ``load.rps`` — only meaningful at
+a ``t:`` offset, and the only action that takes ``rps``).
+
+``serve.max_replicas > replicas`` arms the supervisor-side autoscaler
+(serve/fleet.py::Autoscaler over the replicas' aggregate /metrics.json):
+a spike may scale the fleet out up to ``max_replicas``; S5 requires the
+first ``scale_out`` within ``scale_out_deadline_s`` of a spike.
+``fleet_ttl_s`` is the replicas' lease/drain-token freshness horizon and
+``admission_deadline_ms > 0`` turns on deadline-based admission shedding
+inside every replica.
 
 A malformed spec raises `SpecError` (a ValueError), which `cli.scenario`
 maps to the deterministic rc 2 — same discipline as every other CLI.
@@ -45,7 +63,8 @@ from dataclasses import dataclass, field
 from typing import Dict, List, Optional
 
 _AT_RE = re.compile(r"^(t|publish):(\d+)$")
-_ACTIONS = ("drain_replica", "kill_replica")
+_ACTIONS = ("drain_replica", "kill_replica", "kill_replica_during_wave",
+            "spike_load")
 
 
 class SpecError(ValueError):
@@ -75,6 +94,15 @@ class ServeSpec:
     queue_depth: int = 16
     max_batch: int = 4
     buckets: str = "1,4"
+    # fleet control plane: max_replicas > replicas arms the autoscaler
+    # (0 or == replicas means a fixed fleet); fleet_ttl_s bounds how long
+    # a dead replica can pin the drain token; admission_deadline_ms > 0
+    # turns on deadline shedding inside every replica; the first scale_out
+    # after a spike must land within scale_out_deadline_s (S5)
+    max_replicas: int = 0
+    fleet_ttl_s: float = 6.0
+    admission_deadline_ms: float = 0.0
+    scale_out_deadline_s: float = 60.0
     fault_specs: Dict[int, str] = field(default_factory=dict)
 
 
@@ -97,8 +125,13 @@ class TimelineItem:
     at_value: int   # seconds offset | epoch number
     action: str     # one of _ACTIONS
     replica: int = 0
+    rps: float = 0.0  # spike_load only: offered-load step target
 
     def __str__(self) -> str:
+        if self.action == "spike_load":
+            return f"{self.action}@{self.at_kind}:{self.at_value}(rps={self.rps})"
+        if self.action == "kill_replica_during_wave":
+            return f"{self.action}@{self.at_kind}:{self.at_value}(holder)"
         return f"{self.action}@{self.at_kind}:{self.at_value}(replica={self.replica})"
 
 
@@ -203,19 +236,37 @@ def parse_spec(raw: dict) -> ScenarioSpec:
     if not isinstance(s_raw, dict):
         raise SpecError("serve must be an object")
     _check_keys("serve", s_raw, ("replicas", "poll_s", "queue_depth",
-                                 "max_batch", "buckets", "fault_specs"))
+                                 "max_batch", "buckets", "max_replicas",
+                                 "fleet_ttl_s", "admission_deadline_ms",
+                                 "scale_out_deadline_s", "fault_specs"))
     serve = ServeSpec(
         replicas=_typed("serve", s_raw, "replicas", int, 2),
         poll_s=_typed("serve", s_raw, "poll_s", (int, float), 1.0),
         queue_depth=_typed("serve", s_raw, "queue_depth", int, 16),
         max_batch=_typed("serve", s_raw, "max_batch", int, 4),
         buckets=_typed("serve", s_raw, "buckets", str, "1,4"),
+        max_replicas=_typed("serve", s_raw, "max_replicas", int, 0),
+        fleet_ttl_s=_typed("serve", s_raw, "fleet_ttl_s", (int, float), 6.0),
+        admission_deadline_ms=_typed("serve", s_raw, "admission_deadline_ms",
+                                     (int, float), 0.0),
+        scale_out_deadline_s=_typed("serve", s_raw, "scale_out_deadline_s",
+                                    (int, float), 60.0),
     )
     if serve.replicas < 1:
         raise SpecError("serve.replicas must be >= 1 (the availability floor "
                         "needs someone to answer)")
     if serve.poll_s <= 0:
         raise SpecError("serve.poll_s must be > 0")
+    if serve.max_replicas != 0 and serve.max_replicas < serve.replicas:
+        raise SpecError("serve.max_replicas must be 0 (autoscaler off) or "
+                        f">= replicas={serve.replicas}")
+    if serve.fleet_ttl_s <= 0:
+        raise SpecError("serve.fleet_ttl_s must be > 0")
+    if serve.admission_deadline_ms < 0:
+        raise SpecError("serve.admission_deadline_ms must be >= 0 "
+                        "(0 = admission off)")
+    if serve.scale_out_deadline_s <= 0:
+        raise SpecError("serve.scale_out_deadline_s must be > 0")
     serve.fault_specs = _fault_specs("serve", s_raw, serve.replicas)
 
     l_raw = raw.get("load", {})
@@ -255,7 +306,7 @@ def parse_spec(raw: dict) -> ScenarioSpec:
     for i, it in enumerate(tl):
         if not isinstance(it, dict):
             raise SpecError(f"timeline[{i}] must be an object")
-        _check_keys(f"timeline[{i}]", it, ("at", "action", "replica"))
+        _check_keys(f"timeline[{i}]", it, ("at", "action", "replica", "rps"))
         at = it.get("at", "")
         m = _AT_RE.match(at if isinstance(at, str) else "")
         if not m:
@@ -265,6 +316,36 @@ def parse_spec(raw: dict) -> ScenarioSpec:
         if action not in _ACTIONS:
             raise SpecError(f"timeline[{i}].action {action!r} must be one "
                             f"of {list(_ACTIONS)}")
+        if action == "spike_load":
+            # an offered-load step function: only a wall-clock fire time
+            # makes sense (a publish-gated spike would race the trainer),
+            # and rps is the one parameter it takes
+            if m.group(1) != "t":
+                raise SpecError(f"timeline[{i}]: spike_load fires at "
+                                "'t:SECONDS' (got a publish trigger)")
+            if "replica" in it:
+                raise SpecError(f"timeline[{i}]: spike_load targets the "
+                                "whole fleet, not a replica")
+            rps = it.get("rps", None)
+            if not isinstance(rps, (int, float)) or isinstance(rps, bool) \
+                    or rps <= 0:
+                raise SpecError(f"timeline[{i}]: spike_load needs rps > 0, "
+                                f"got {rps!r}")
+            items.append(TimelineItem(m.group(1), int(m.group(2)), action,
+                                      rps=float(rps)))
+            continue
+        if "rps" in it:
+            raise SpecError(f"timeline[{i}]: rps is only valid with "
+                            "spike_load")
+        if action == "kill_replica_during_wave":
+            # the target is whoever holds the drain token when the wave is
+            # in flight — a fixed replica index would race the wave order
+            if "replica" in it:
+                raise SpecError(f"timeline[{i}]: kill_replica_during_wave "
+                                "kills the drain-token holder; it takes no "
+                                "replica index")
+            items.append(TimelineItem(m.group(1), int(m.group(2)), action))
+            continue
         replica = it.get("replica", 0)
         if not isinstance(replica, int) or isinstance(replica, bool) or \
                 not 0 <= replica < serve.replicas:

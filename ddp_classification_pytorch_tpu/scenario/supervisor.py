@@ -3,14 +3,29 @@
 One process supervises the whole drill: it launches an elastic trainer pod
 (every host under `scripts/supervise.sh` in its own session, exactly like
 chaos_drill.sh phase 6), N serve replicas (`cli.serve --watch` over the
-shared run dir), and a load-generator thread sustaining offered RPS with
-replica failover; drives the declarative timeline (drain/kill a replica at
-a wall-clock offset or when a given epoch publishes); relaunches a host the
-chaos plan SIGKILLed once the survivors re-form around its absence; and on
-completion runs the analyzer gate (`scripts/lint.sh`). Every transition
-lands in the shared `events.jsonl` — the supervisor's own record plus what
-the trainer/serve processes emit through `scenario.events.emit` — which the
-invariant checker then replays.
+shared run dir, each a member of the serve fleet via `--fleet_dir`), and a
+load-generator thread sustaining offered RPS with replica failover; drives
+the declarative timeline (drain/kill a replica at a wall-clock offset or
+when a given epoch publishes; step the offered load with `spike_load`;
+SIGKILL the drain-token holder with `kill_replica_during_wave`);
+relaunches a host the chaos plan SIGKILLed once the survivors re-form
+around its absence; and on completion runs the analyzer gate
+(`scripts/lint.sh`). Every transition lands in the shared `events.jsonl` —
+the supervisor's own record plus what the trainer/serve processes emit
+through `scenario.events.emit` — which the invariant checker then replays.
+
+When `serve.max_replicas > replicas` the supervisor also runs the
+autoscaler loop: it aggregates the replicas' /metrics.json gauges (sum of
+queue depth, mean batch fill, max p99) into `serve.fleet.Autoscaler`
+samples and applies the decisions — launching fresh replicas (`scale_out`)
+or retiring the highest-index one (`scale_in` + `replica_retire`, a
+graceful SIGTERM drain that is NOT relaunched). The reactive gauges are
+supplemented with the demand signal the supervisor owns anyway: a
+closed-loop single-flight load generator can never build a server-side
+queue (it waits for each answer before sending the next), so the offered
+rps relative to the baseline provisioning ratio (load.rps / replicas)
+also raises the desired count — which is what makes a `spike_load` step
+deterministically produce the `scale_out` S5 audits.
 
 Process-level faults are NOT injected here: each trainer host and serve
 replica gets its own ``CHAOS_FAULT_SPEC`` (utils/chaos.py), so the fault
@@ -38,6 +53,7 @@ import time
 from typing import Dict, List, Optional
 
 from ..obs.events import ENV_EVENTS, ENV_SOURCE, EventLog, read_events
+from ..serve.fleet import Autoscaler  # stdlib-only (serve/__init__ is lazy)
 from .invariants import good_publishes
 from .spec import ScenarioSpec
 
@@ -65,7 +81,9 @@ class _Replica:
         self.proc: Optional[subprocess.Popen] = None
         self.log_fh = None
         # "running" | "draining" | "killed" (deliberate stops pending
-        # relaunch) — an exit in state "running" is an unexpected death
+        # relaunch) | "retired" (autoscaler scale-in: drains, is NOT
+        # relaunched, and stops being a load/adoption target) — an exit
+        # in state "running" is an unexpected death
         self.state = "running"
 
     @property
@@ -101,6 +119,12 @@ class ScenarioSupervisor:
         self._load_thread: Optional[threading.Thread] = None
         self._fired_timeline: set = set()
         self._t0 = 0.0
+        # offered-load target, stepped by spike_load timeline items; the
+        # load thread re-reads it every period (float store is atomic)
+        self._rps = float(spec.load.rps)
+        self._scaler: Optional[Autoscaler] = None
+        self._next_replica_index = spec.serve.replicas
+        self._last_scale_sample = -1.0e18
 
     # ------------------------------------------------------------ launches --
     def _trainer_env(self, host: int) -> Dict[str, str]:
@@ -182,21 +206,30 @@ class ScenarioSupervisor:
     def _replica_cmd(self, rep: _Replica) -> List[str]:
         sp, sv = self.spec.trainer, self.spec.serve
         rep_out = os.path.join(self.out_dir, f"replica{rep.index}")
-        return [sys.executable, "-m", f"{_PKG}.cli.serve", "baseline",
-                "--model", sp.model, "--variant", sp.variant,
-                "--dtype", "float32",
-                "--num_classes", str(sp.num_classes),
-                "--image_size", str(sp.image_size),
-                "--topk", str(min(5, sp.num_classes)),
-                "--platform", "cpu",
-                "--watch", self.out_dir,
-                "--reload_poll_s", str(sv.poll_s),
-                "--port", str(rep.port),
-                "--queue_depth", str(sv.queue_depth),
-                "--buckets", sv.buckets,
-                "--max_batch", str(sv.max_batch),
-                "--out", rep_out,
-                "--log_every_s", "10"]
+        cmd = [sys.executable, "-m", f"{_PKG}.cli.serve", "baseline",
+               "--model", sp.model, "--variant", sp.variant,
+               "--dtype", "float32",
+               "--num_classes", str(sp.num_classes),
+               "--image_size", str(sp.image_size),
+               "--topk", str(min(5, sp.num_classes)),
+               "--platform", "cpu",
+               "--watch", self.out_dir,
+               "--reload_poll_s", str(sv.poll_s),
+               "--port", str(rep.port),
+               "--queue_depth", str(sv.queue_depth),
+               "--buckets", sv.buckets,
+               "--max_batch", str(sv.max_batch),
+               # every replica is a fleet member over the shared run dir:
+               # leases + the drain token turn concurrent reloads into a
+               # rolling wave (at most one replica draining — S5)
+               "--fleet_dir", self.out_dir,
+               "--fleet_replica", str(rep.index),
+               "--fleet_ttl_s", str(sv.fleet_ttl_s),
+               "--out", rep_out,
+               "--log_every_s", "10"]
+        if sv.admission_deadline_ms > 0:
+            cmd += ["--admission_deadline_ms", str(sv.admission_deadline_ms)]
+        return cmd
 
     def _launch_replica(self, rep: _Replica) -> None:
         os.makedirs(os.path.join(self.out_dir, f"replica{rep.index}"),
@@ -260,15 +293,20 @@ class ScenarioSupervisor:
 
         log = EventLog(self.events_path, "loadgen")
         payload = self._make_payload()
-        period = 1.0 / self.spec.load.rps
         n = 0
-        while not self._load_stop.wait(period):
-            order = [(n + k) % len(self.replicas)
-                     for k in range(len(self.replicas))]
+        # period is re-derived every iteration: spike_load steps self._rps
+        # mid-run, and the autoscaler grows/retires self.replicas mid-run
+        # (snapshot the list; retired replicas stop being targets)
+        while not self._load_stop.wait(1.0 / self._rps):
+            reps = [r for r in self.replicas if r.state != "retired"]
+            if not reps:
+                log.emit("request", status="refused", replica="-")
+                continue
+            order = [(n + k) % len(reps) for k in range(len(reps))]
             n += 1
             answered = False
             for i in order:
-                rep = self.replicas[i]
+                rep = reps[i]
                 req = urllib.request.Request(
                     f"http://127.0.0.1:{rep.port}/predict", data=payload,
                     headers={"Content-Type": "image/png"})
@@ -305,6 +343,29 @@ class ScenarioSupervisor:
                 log.emit("request", status="refused", replica="-")
 
     # ------------------------------------------------------------ timeline --
+    def _wave_kill_target(self, events: List[Dict]) -> Optional[_Replica]:
+        """The replica currently holding the fleet's drain token (or the
+        most recent acquirer when the wave just closed): replay the
+        drain_token_acquire/release stream the fleet members emit. A
+        takeover acquire overwrites the wedged holder — exactly the
+        last-writer-wins semantics of the token file itself."""
+        holder = None
+        last_acquirer = None
+        for e in events:
+            kind = e.get("kind")
+            if kind == "drain_token_acquire":
+                holder = last_acquirer = str(e.get("source", ""))
+            elif kind == "drain_token_release" \
+                    and str(e.get("source", "")) == holder:
+                holder = None
+        name = holder or last_acquirer
+        if name is None:
+            return None
+        for rep in self.replicas:
+            if rep.source == name:
+                return rep
+        return None
+
     def _fire_timeline(self, events: List[Dict], elapsed: float) -> None:
         for idx, item in enumerate(self.spec.timeline):
             if idx in self._fired_timeline:
@@ -314,6 +375,28 @@ class ScenarioSupervisor:
                        and int(e.get("epoch", -1)) >= item.at_value
                        for e in events))
             if not due:
+                continue
+            if item.action == "spike_load":
+                self._fired_timeline.add(idx)
+                self.log.emit("timeline", action=str(item))
+                self._rps = float(item.rps)
+                # the S5 scale-out deadline is measured from this event
+                self.log.emit("spike_load", rps=item.rps)
+                continue
+            if item.action == "kill_replica_during_wave":
+                # stays ARMED past its fire time until a rolling wave is
+                # actually in flight — the 0.5s poll would otherwise race
+                # short acquire→release windows and kill nobody
+                target = self._wave_kill_target(events)
+                if target is None or target.proc is None \
+                        or target.proc.poll() is not None \
+                        or target.state != "running":
+                    continue
+                self._fired_timeline.add(idx)
+                self.log.emit("timeline", action=str(item),
+                              target=target.source)
+                target.state = "killed"
+                target.proc.kill()
                 continue
             self._fired_timeline.add(idx)
             rep = self.replicas[item.replica]
@@ -380,6 +463,19 @@ class ScenarioSupervisor:
             rc = rep.proc.poll()
             if rc is None:
                 continue
+            if rep.state == "retired":
+                # scale-in: the drain was deliberate and FINAL — no
+                # relaunch; a dirty exit still fails the run
+                if rc != 0:
+                    self.failures.append(
+                        f"{rep.source} retire drain exited rc={rc}, want 0")
+                self.log.emit("replica_stop", replica=rep.source, rc=rc,
+                              deliberate=True)
+                if rep.log_fh is not None:
+                    rep.log_fh.close()
+                    rep.log_fh = None
+                rep.proc = None
+                continue
             if rep.state in ("draining", "killed"):
                 if rep.state == "draining" and rc != 0:
                     self.failures.append(
@@ -395,6 +491,90 @@ class ScenarioSupervisor:
                               deliberate=False)
                 self._launch_replica(rep)  # keep the fleet at strength
 
+    # ---------------------------------------------------------- autoscale --
+    def _sample_metrics(self) -> Optional[Dict]:
+        """Aggregate the live replicas' /metrics.json into one Autoscaler
+        sample: queue depth SUMS (total backlog), fill averages, p99 takes
+        the worst replica (an SLO is only as good as the slowest path)."""
+        import urllib.request
+
+        depth, fills, p99s = 0.0, [], []
+        for rep in self.replicas:
+            if rep.state == "retired" or rep.proc is None \
+                    or rep.proc.poll() is not None:
+                continue
+            try:
+                with urllib.request.urlopen(
+                        f"http://127.0.0.1:{rep.port}/metrics.json",
+                        timeout=2.0) as resp:
+                    snap = json.loads(resp.read().decode())
+            except Exception:
+                continue  # warming up / mid-drain: not a sample
+            depth += float(snap.get("queue_depth", 0) or 0)
+            fills.append(float(snap.get("fill_ratio", 0.0) or 0.0))
+            p99s.append(float(snap.get("p99_ms", 0.0) or 0.0))
+        if not fills:
+            return None
+        return {"queue_depth": depth,
+                "fill_ratio": sum(fills) / len(fills),
+                "p99_ms": max(p99s)}
+
+    def _autoscale(self, now: float) -> None:
+        if self._scaler is None or now - self._last_scale_sample < 2.0:
+            return
+        self._last_scale_sample = now
+        sample = self._sample_metrics()
+        if sample is None:
+            return
+        live = [r for r in self.replicas
+                if r.state != "retired" and r.proc is not None]
+        current = len(live)
+        if current < 1:
+            return
+        # reconcile with reality before deciding: kills/relaunches move the
+        # count under the scaler's feet
+        self._scaler.replicas = current
+        want = self._scaler.decide(sample, now)
+        # demand supplement (see module docstring): offered rps over the
+        # baseline provisioning ratio raises the target too, one step per
+        # cycle, honoring the same cooldown the reactive path uses
+        per_rep = self.spec.load.rps / max(self.spec.serve.replicas, 1)
+        demand = -(-self._rps // per_rep) if per_rep > 0 else current
+        demand = max(self._scaler.min_replicas,
+                     min(int(demand), self._scaler.max_replicas))
+        if demand > current and \
+                now - self._scaler.last_action_t >= self._scaler.cooldown_s:
+            want = max(want, current + 1)
+        elif want < current and demand >= current:
+            # the offered load still justifies the current count: an empty
+            # queue is the closed-loop generator's artifact, not slack —
+            # scaling in here would flap against the demand floor forever
+            want = current
+        if want > current:
+            rep = _Replica(self._next_replica_index, free_port())
+            self._next_replica_index += 1
+            self.replicas.append(rep)
+            self._launch_replica(rep)
+            self.log.emit("scale_out", replica=rep.source,
+                          replicas=current + 1,
+                          queue_depth=sample["queue_depth"],
+                          p99_ms=sample["p99_ms"], offered_rps=self._rps)
+            self._scaler.applied(current + 1, now)
+        elif want < current:
+            victim = max(live, key=lambda r: r.index)
+            if victim.proc is None or victim.proc.poll() is not None:
+                return
+            victim.state = "retired"
+            victim.proc.send_signal(signal.SIGTERM)
+            self.log.emit("scale_in", replica=victim.source,
+                          replicas=current - 1,
+                          queue_depth=sample["queue_depth"],
+                          fill_ratio=sample["fill_ratio"])
+            # S3 reads this: the replica is excused from adopting
+            # publishes whose deadline lands after its retirement
+            self.log.emit("replica_retire", replica=victim.source)
+            self._scaler.applied(current - 1, now)
+
     def _hosts_done(self) -> bool:
         return all(h.state == "done" for h in self.hosts)
 
@@ -406,8 +586,10 @@ class ScenarioSupervisor:
         """Before stopping load: give every replica its chance to pick up
         the last good publish (S3's deadline is the bound)."""
         deadline = time.monotonic() + self.spec.adopt_deadline_s
-        want = {r.source for r in self.replicas}
         while time.monotonic() < deadline:
+            # recomputed every pass: a scale-out adds sources that must
+            # adopt too; a retirement removes one that never will again
+            want = {r.source for r in self.replicas if r.state != "retired"}
             events = read_events(self.events_path)
             goods = good_publishes(events)
             if not goods:
@@ -491,6 +673,13 @@ class ScenarioSupervisor:
             self.hosts = [_Host(i) for i in range(self.spec.trainer.hosts)]
             self.replicas = [_Replica(i, free_port())
                              for i in range(self.spec.serve.replicas)]
+            sv = self.spec.serve
+            if sv.max_replicas > sv.replicas:
+                self._scaler = Autoscaler(
+                    min_replicas=sv.replicas, max_replicas=sv.max_replicas,
+                    p99_slo_ms=sv.admission_deadline_ms,
+                    queue_high=max(sv.queue_depth // 2, 2),
+                    cooldown_s=5.0, replicas=sv.replicas)
             for host in self.hosts:
                 self._launch_host(host)
             for rep in self.replicas:
@@ -511,6 +700,7 @@ class ScenarioSupervisor:
                 self._fire_timeline(events, elapsed)
                 self._poll_hosts()
                 self._poll_replicas()
+                self._autoscale(time.monotonic() - self._t0)
                 if self._hosts_failed():
                     return self._finish(aborted=True)
                 if self._hosts_done():

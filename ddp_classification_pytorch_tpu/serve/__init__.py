@@ -1,18 +1,35 @@
 """Inference serving subsystem: micro-batching engine (bounded queue +
 deadline batcher + bucketed jit), checkpoint hot-reload with quarantine,
-and serving metrics — built from the training stack's own primitives
-(jitted predict with the uint8 device epilogue, CheckpointManager's
-verified restore). Entry point: `cli/serve.py`; runbook: docs/serving.md."""
+fleet control plane (replica registry, rolling reload waves, admission,
+autoscaling policy), and serving metrics — built from the training stack's
+own primitives (jitted predict with the uint8 device epilogue,
+CheckpointManager's verified restore). Entry point: `cli/serve.py`;
+runbook: docs/serving.md.
 
-from .engine import EngineClosed, Prediction, QueueFull, ServingEngine
-from .metrics import ServeMetrics
-from .reload import CheckpointWatcher
+Attribute access is lazy (PEP 562): `serve.fleet` and the scenario
+supervisor are stdlib-only, so importing the package must not drag jax in
+through `engine` until someone actually asks for the engine.
+"""
 
-__all__ = [
-    "ServingEngine",
-    "Prediction",
-    "QueueFull",
-    "EngineClosed",
-    "ServeMetrics",
-    "CheckpointWatcher",
-]
+import importlib
+
+_LAZY = {
+    "ServingEngine": ".engine",
+    "Prediction": ".engine",
+    "QueueFull": ".engine",
+    "EngineClosed": ".engine",
+    "ServeMetrics": ".metrics",
+    "CheckpointWatcher": ".reload",
+}
+
+__all__ = list(_LAZY)
+
+
+def __getattr__(name):
+    if name in _LAZY:
+        return getattr(importlib.import_module(_LAZY[name], __name__), name)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
+
+
+def __dir__():
+    return sorted(set(globals()) | set(_LAZY))

@@ -36,6 +36,13 @@ The engine is fully exercisable in-process: construct it without `start()`
 and drive `process_once()` directly — no thread, no socket (how the tier-1
 tests and `bench.py --serve` use it). The stdlib HTTP front-end
 (serve/http.py) is a thin layer over `submit()`.
+
+One engine is one replica's data plane. The fleet control plane
+(serve/fleet.py) layers on top without reaching in: the admission
+controller wraps `submit()` (deadline shedding above this queue's memory
+bound), the replica registry heartbeats around the watcher that calls
+`swap_state()`, and the rolling wave serializes WHEN `swap_state` may be
+called — the engine itself stays single-replica and policy-free.
 """
 
 from __future__ import annotations
@@ -203,6 +210,13 @@ class ServingEngine:
     @property
     def queue_depth(self) -> int:
         return self._q.qsize()
+
+    @property
+    def queue_capacity(self) -> int:
+        """The configured intake bound — a MEMORY guard, distinct from the
+        admission layer's latency policy (serve/fleet.py), which sheds on
+        measured wait long before this bound is reached."""
+        return self._q.maxsize
 
     @property
     def closed(self) -> bool:

@@ -4,26 +4,38 @@ The engine is the product (fully exercisable in-process, no sockets); this
 module only maps HTTP onto it with `http.server` from the standard library —
 no web framework, matching the repo's zero-new-deps rule:
 
-    POST /predict   body = an image file (anything PIL opens: JPEG/PNG)
+    POST /predict   body = an image file (anything PIL opens: JPEG/PNG);
+                    optional X-Tenant header routes the request through the
+                    admission controller's per-tenant weighted queues
                     → 200 {"topk": [[class, score], ...], "latency_ms": N,
                            "digest": <params sha256>, "generation": N}
-                    → 503 {"state": "busy"} + Retry-After: 1 (queue full —
-                      backpressure, retry soon) or {"state": "draining"} +
-                      Retry-After: 5 (replica going away — pick another)
+                    → 503 {"state": "busy", "queue_depth": N,
+                           "shed_tenant": <tenant>} + Retry-After: 1
+                      (backpressure — queue full or admission shed; the
+                      depth and shed tenant make S5 forensics readable
+                      straight off events.jsonl) or {"state": "draining",
+                      "queue_depth": N} + Retry-After: 5 (replica going
+                      away — pick another)
                     → 400 on undecodable bodies
     GET  /healthz   → 200 {"ok": ..., "digest": ..., "generation": ...,
-                           "watcher_alive": ..., ...metrics snapshot}
+                           "watcher_alive": ..., "fleet_role": ...,
+                           "wave_state": ..., "lease_generation": ...,
+                           ...metrics snapshot}
                       (Content-Type: application/json)
     GET  /metrics   → 200 Prometheus text exposition of the engine's
-                      registry (serve_*, engine_*, watcher_* families;
-                      Content-Type: text/plain; version=0.0.4)
+                      registry (serve_*, engine_*, watcher_*, fleet_*,
+                      admission_* families; text/plain; version=0.0.4)
     GET  /metrics.json → 200 legacy metrics snapshot JSON (same dict
                       /healthz embeds)
 
 A load balancer (or the scenario supervisor) reads /healthz to tell
 degraded from dead: `ok` false means draining, `watcher_alive` false means
 hot-reload stopped (stale-params risk even though requests still answer),
-and digest/generation attest exactly which verified checkpoint is serving.
+digest/generation attest exactly which verified checkpoint is serving, and
+the fleet fields (`fleet_role` leader|follower, `wave_state`
+joining|serving|draining, `lease_generation`) place this replica in the
+rolling-wave protocol — `wave_state: draining` is the one-at-a-time slot
+the S5 invariant audits.
 
 `ThreadingHTTPServer` gives one handler thread per connection; every handler
 just blocks on its request future, so concurrency is bounded by the engine's
@@ -39,12 +51,15 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any, Optional
 
 from .engine import EngineClosed, QueueFull
+from .fleet import AdmissionShed
 
 
 class ServeHandler(BaseHTTPRequestHandler):
     # set by make_server on the handler class
     engine: Any = None
     watcher: Any = None  # CheckpointWatcher when serving with --watch
+    fleet: Any = None  # FleetMember when serving with --fleet_dir
+    admission: Any = None  # AdmissionController when admission is on
     request_timeout_s: float = 30.0
 
     def _json(self, code: int, payload: dict,
@@ -70,7 +85,8 @@ class ServeHandler(BaseHTTPRequestHandler):
         if self.path == "/metrics":
             # Prometheus scrape endpoint: text exposition of every
             # instrument registered against this engine's registry (the
-            # watcher shares it, so watcher_* families appear here too)
+            # watcher and fleet member share it, so watcher_* / fleet_* /
+            # admission_* families appear here too)
             self._text(200, self.engine.metrics.registry.expose(),
                        "text/plain; version=0.0.4")
             return
@@ -85,6 +101,15 @@ class ServeHandler(BaseHTTPRequestHandler):
                     # False = the reload thread died — stale-params risk
                     "watcher_alive": (self.watcher.alive
                                       if self.watcher is not None else None),
+                    # fleet placement: None = lone replica (no --fleet_dir);
+                    # else role from the lease scan and this replica's slot
+                    # in the rolling wave (S5 audits the draining slots)
+                    "fleet_role": (self.fleet.role()
+                                   if self.fleet is not None else None),
+                    "wave_state": (self.fleet.state
+                                   if self.fleet is not None else None),
+                    "lease_generation": (self.fleet.generation
+                                         if self.fleet is not None else None),
                     **snap,
                 }
             self._json(200, snap)
@@ -95,6 +120,7 @@ class ServeHandler(BaseHTTPRequestHandler):
         if self.path != "/predict":
             self._json(404, {"error": f"unknown path {self.path!r}"})
             return
+        tenant = self.headers.get("X-Tenant", "default") or "default"
         length = int(self.headers.get("Content-Length", 0))
         body = self.rfile.read(length)
         try:
@@ -106,18 +132,34 @@ class ServeHandler(BaseHTTPRequestHandler):
             self._json(400, {"error": f"cannot decode image: {e}"})
             return
         try:
-            future = self.engine.submit_image(img)
+            if self.admission is not None:
+                future = self.admission.submit_image(img, tenant=tenant)
+            else:
+                future = self.engine.submit_image(img)
             pred = future.result(timeout=self.request_timeout_s)
+        except AdmissionShed as e:
+            # admission policy shed: measured wait exceeded the deadline.
+            # The body carries the forensics S5 reads off events.jsonl —
+            # the measured depth at decision time and which tenant paid
+            self._json(503, {"error": str(e), "state": "busy",
+                             "queue_depth": e.queue_depth,
+                             "shed_tenant": e.tenant,
+                             "est_wait_ms": round(e.est_wait_ms, 1)},
+                       headers={"Retry-After": "1"})
+            return
         except QueueFull as e:
             # backpressure: the queue will turn over within a batch or two —
             # retry against the SAME replica shortly
-            self._json(503, {"error": str(e), "state": "busy"},
+            self._json(503, {"error": str(e), "state": "busy",
+                             "queue_depth": self.engine.queue_depth,
+                             "shed_tenant": tenant},
                        headers={"Retry-After": "1"})
             return
         except EngineClosed as e:
             # draining: this replica is going away — clients should go to
             # another replica; Retry-After covers a typical relaunch
-            self._json(503, {"error": str(e), "state": "draining"},
+            self._json(503, {"error": str(e), "state": "draining",
+                             "queue_depth": self.engine.queue_depth},
                        headers={"Retry-After": "5"})
             return
         except Exception as e:
@@ -136,19 +178,22 @@ class ServeHandler(BaseHTTPRequestHandler):
 
 
 def make_server(engine: Any, port: int, request_timeout_s: float = 30.0,
-                watcher: Any = None) -> ThreadingHTTPServer:
+                watcher: Any = None, fleet: Any = None,
+                admission: Any = None) -> ThreadingHTTPServer:
     """Bind a ThreadingHTTPServer over `engine` (not yet serving)."""
     handler = type("BoundServeHandler", (ServeHandler,), {
-        "engine": engine, "watcher": watcher,
-        "request_timeout_s": request_timeout_s})
+        "engine": engine, "watcher": watcher, "fleet": fleet,
+        "admission": admission, "request_timeout_s": request_timeout_s})
     return ThreadingHTTPServer(("0.0.0.0", port), handler)
 
 
-def start_server(engine: Any, port: int,
-                 watcher: Any = None) -> ThreadingHTTPServer:
+def start_server(engine: Any, port: int, watcher: Any = None,
+                 fleet: Any = None, admission: Any = None
+                 ) -> ThreadingHTTPServer:
     """Serve on a daemon thread; caller owns shutdown (`server.shutdown()`
     before `engine.drain()` so no handler blocks on a draining engine)."""
-    server = make_server(engine, port, watcher=watcher)
+    server = make_server(engine, port, watcher=watcher, fleet=fleet,
+                         admission=admission)
     threading.Thread(target=server.serve_forever, daemon=True,
                      name="serve-http").start()
     return server

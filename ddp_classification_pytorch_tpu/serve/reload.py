@@ -29,6 +29,14 @@ The watcher never dies quietly: `alive` is surfaced in /healthz, and the
 error/backoff transitions land in the scenario event log. A dead watcher
 would mean a replica serving stale params forever with no signal — the
 failure mode this module refuses to have.
+
+Under a serve fleet (serve/fleet.py) the watcher is also the replica's
+heartbeat: every poll tick rewrites the fleet lease (piggybacked on
+`check_once`, so a wedged watcher thread == a stale lease, visible to the
+registry instead of a silently frozen replica), and the hot swap itself is
+token-gated — the replica only drains-and-swaps while holding the fleet's
+single drain token, which is what makes the reload a rolling wave with at
+most one replica out at a time.
 """
 
 from __future__ import annotations
@@ -56,6 +64,7 @@ class CheckpointWatcher:
         metrics: Optional[Any] = None,
         chaos: Optional[Any] = None,
         max_backoff_s: float = 30.0,
+        fleet: Optional[Any] = None,
     ):
         self.manager = CheckpointManager(
             run_dir, save_every_epoch=False, async_save=False)
@@ -65,6 +74,7 @@ class CheckpointWatcher:
         self.max_backoff_s = max(float(max_backoff_s), self.poll_s)
         self.metrics = metrics
         self.chaos = chaos  # FaultPlan for watcher_io drills; None = never
+        self.fleet = fleet  # FleetMember; poll tick doubles as heartbeat
         # newest epoch actually serving; candidates at or below it are not
         # re-loaded (an epoch file is written once — atomic rename — so
         # same-epoch mutation is not a case worth polling for)
@@ -123,6 +133,11 @@ class CheckpointWatcher:
                                    generation=epoch)
             self.loaded_epoch = epoch
             emit("swap", epoch=epoch, digest=digest or "")
+        if self.fleet is not None:
+            # announce ourselves before the first poll tick: a joining
+            # replica should appear in the registry as soon as it serves
+            self.fleet.heartbeat(digest=self.engine.params_digest,
+                                 generation=self.engine.params_generation)
         return self.loaded_epoch
 
     def check_once(self) -> bool:
@@ -133,6 +148,12 @@ class CheckpointWatcher:
         backoff layer); direct callers see them raw."""
         self.polls += 1
         self._polls_total.inc()
+        if self.fleet is not None:
+            # the lease rewrite IS the replica heartbeat: piggybacking it
+            # on the poll tick means a wedged watcher goes visibly stale
+            # instead of silently serving old params forever
+            self.fleet.heartbeat(digest=self.engine.params_digest,
+                                 generation=self.engine.params_generation)
         if self.chaos:
             self.chaos.maybe_fail_watcher_poll(poll=self.polls)
         for e in sorted(self.manager._epoch_checkpoints(), reverse=True):
@@ -162,11 +183,24 @@ class CheckpointWatcher:
                             f"still serving epoch {self.loaded_epoch}")
                 continue
             digest = self._digest_of(path)
+            if self.fleet is not None \
+                    and not self.fleet.try_begin_drain(digest):
+                # another replica holds the fleet's drain token: our wave
+                # slot comes on a later poll (or after its lease/token
+                # goes TTL-stale and we take the token over). Serving
+                # continues on the current params — nothing is dropped.
+                host0_print(f"[serve] reload to epoch {e} waiting for the "
+                            "fleet drain token (rolling wave)")
+                return False
             emit("verify_ok", epoch=e, path=path, digest=digest)
             self.engine.swap_state(state, digest=digest, generation=e)
             self.loaded_epoch = e
             emit("swap", epoch=e, digest=digest)
             self._swaps_total.inc()
+            if self.fleet is not None:
+                # swap adopted at the next batch boundary; release our
+                # wave slot with the digest we now serve
+                self.fleet.end_drain(digest=digest, generation=e)
             if self.metrics is not None:
                 self.metrics.record_reload(ok=True)
             host0_print(f"[serve] hot-reloaded checkpoint epoch {e}")

@@ -55,8 +55,17 @@
 # then the S1–S4 invariants (verified-serve, availability floor, bounded
 # adoption, analyzer gate) machine-checked from events.jsonl.
 #
+# Phase 9 (serve-fleet drill, must converge to rc 0): the fleet control
+# plane under fire — 2 replicas sharing leases + the rolling drain token
+# over the trainer's run dir, admission deadline shedding armed, with a
+# torn epoch-0 publish, the drain-token HOLDER SIGKILLed mid-wave (the
+# lease-TTL hand-off), and a spike_load step that must drive the
+# autoscaler to scale_out within its deadline — then S1–S5 (S5: wave
+# exclusivity, survivor digest convergence, spike→scale-out bound)
+# machine-checked from events.jsonl.
+#
 # CPU-only, synthetic data, tiny model: runs anywhere in a few minutes.
-# Select phases with CHAOS_PHASES (default "1 2 3 4 5 6 7 8"); the pod
+# Select phases with CHAOS_PHASES (default "1 2 3 4 5 6 7 8 9"); the pod
 # phases skip gracefully when the platform cannot host two CPU processes
 # (a forced non-cpu JAX_PLATFORMS means only one host's worth of real
 # devices is available).
@@ -64,7 +73,7 @@
 set -u
 REPO=$(cd "$(dirname "$0")/.." && pwd)
 OUT=${1:-"$REPO/runs/chaos_drill"}
-PHASES=${CHAOS_PHASES:-"1 2 3 4 5 6 7 8"}
+PHASES=${CHAOS_PHASES:-"1 2 3 4 5 6 7 8 9"}
 export JAX_PLATFORMS=${JAX_PLATFORMS:-cpu}
 
 COMMON=(baseline --dataset synthetic --platform cpu --model resnet18
@@ -421,6 +430,64 @@ grep -q "rc=11" "$P8/restarts.log" \
 echo "[drill] phase 8 OK: train→serve scenario green —" \
      "$(grep -c '"kind": "request"' "$P8/events.jsonl") requests under chaos," \
      "all four invariants held"
+fi
+fi
+
+# ---------------------------------------------------------------- phase 9 --
+if has_phase 9; then
+if ! pod_available; then
+  echo "[drill] phase 9 SKIPPED: the fleet drill needs the CPU" \
+       "virtual-device harness"
+else
+P9="$OUT/fleet_scenario"
+rm -rf "$P9"; mkdir -p "$P9"
+SPEC9="$P9/spec.json"
+# the fleet drill: rolling waves + admission + autoscaling under fire —
+# a torn epoch-0 publish, the drain-token holder SIGKILLed once a wave
+# is in flight (TTL hand-off), and an offered-load spike the autoscaler
+# must answer with a scale_out inside its deadline
+cat > "$SPEC9" <<'JSON'
+{
+  "trainer": {
+    "hosts": 2, "elastic": true, "min_processes": 1, "epochs": 4,
+    "fault_specs": {"0": "ckpt_io@epoch=0,publish_corrupt@epoch=2"}
+  },
+  "serve": {
+    "replicas": 2, "poll_s": 1.0, "max_replicas": 3, "fleet_ttl_s": 6.0,
+    "admission_deadline_ms": 250.0, "scale_out_deadline_s": 60.0
+  },
+  "load": {"rps": 3.0, "timeout_s": 20.0},
+  "availability": {"floor": 0.5, "window_s": 10.0, "min_samples": 3},
+  "adopt_deadline_s": 180.0,
+  "deadline_s": 900.0,
+  "timeline": [{"at": "t:5", "action": "kill_replica_during_wave"},
+               {"at": "t:25", "action": "spike_load", "rps": 10.0}]
+}
+JSON
+echo "[drill] phase 9: serve-fleet scenario (rolling wave + admission +" \
+     "autoscaler) via scripts/scenario.sh"
+bash "$REPO/scripts/scenario.sh" "$P9" "$SPEC9" 2>&1 | tee "$P9/drill.log"
+rc=${PIPESTATUS[0]}
+[ "$rc" -eq 0 ] || fail "phase 9 exited rc=$rc, want 0 (see $P9/drill.log)"
+grep -q "GREEN: S1 verified-serve" "$P9/drill.log" \
+  || fail "the invariant checker never declared the fleet run green"
+grep -q "S5 fleet" "$P9/drill.log" \
+  || fail "the green line never named the S5 fleet invariant"
+[ -s "$P9/events.jsonl" ] || fail "events.jsonl missing or empty"
+grep -q '"kind": "publish_torn"' "$P9/events.jsonl" \
+  || fail "no publish_torn event — the torn-publish fault never fired"
+grep -q '"kind": "drain_token_acquire"' "$P9/events.jsonl" \
+  || fail "no drain_token_acquire — the replicas never ran a rolling wave"
+grep -q '"kind": "spike_load"' "$P9/events.jsonl" \
+  || fail "no spike_load event — the offered-load step never fired"
+grep -q '"kind": "scale_out"' "$P9/events.jsonl" \
+  || fail "no scale_out event — the autoscaler never answered the spike"
+grep -q 'kill_replica_during_wave@' "$P9/events.jsonl" \
+  || fail "the mid-wave kill never fired (no armed timeline hit)"
+echo "[drill] phase 9 OK: serve-fleet scenario green —" \
+     "$(grep -c '"kind": "drain_token_acquire"' "$P9/events.jsonl") wave slots," \
+     "$(grep -c '"kind": "scale_out"' "$P9/events.jsonl") scale-out(s)," \
+     "all five invariants held"
 fi
 fi
 

@@ -3,12 +3,16 @@
 # (scenario/; runbook: docs/operations.md "Scenario drill").
 #
 # Launches an elastic trainer pod under supervise.sh publishing verified
-# checkpoints into a shared run dir, serve replicas hot-reloading from it
-# under offered HTTP load, injects the spec's chaos timeline (NaN burst,
-# torn + corrupt-published checkpoints, host SIGKILL, watcher fs flake,
-# reload-during-drain), then machine-checks the S1–S4 invariants from the
-# recorded events.jsonl. Exits with cli.scenario's code: 0 green,
-# 1 invariant violated / process failed, 2 malformed spec.
+# checkpoints into a shared run dir, serve replicas (fleet members: shared
+# leases + rolling-wave drain token) hot-reloading from it under offered
+# HTTP load, injects the spec's chaos timeline (NaN burst, torn +
+# corrupt-published checkpoints, host SIGKILL, watcher fs flake,
+# reload-during-drain; specs may also step the offered load with
+# spike_load and SIGKILL the wave's token holder), then machine-checks
+# the S1–S5 invariants from the recorded events.jsonl. Exits with
+# cli.scenario's code: 0 green, 1 invariant violated / process failed,
+# 2 malformed spec. The fleet drill with autoscaling is chaos_drill.sh
+# phase 9, which passes its own spec here.
 #
 #   bash scripts/scenario.sh                         # default drill
 #   bash scripts/scenario.sh runs/s my_spec.json     # custom out + spec

@@ -14,15 +14,38 @@
 # defaults to the CLI's auto-buckets, which round themselves up to the
 # mesh's dp width; an explicit BUCKETS list must be dp-divisible (rc 2).
 #
+# A replica launched with FLEET_DIR joins the serve-fleet control plane
+# (docs/serving.md "Fleet"): it heartbeats a lease into
+# $FLEET_DIR/serve_fleet on every watcher poll, gates its hot-reload
+# swaps on the fleet's single drain token (rolling waves — at most one
+# replica draining at a time), and reports fleet_role/wave_state on
+# /healthz. ADMISSION_DEADLINE_MS > 0 turns on deadline-based load
+# shedding above the batch queue; ADMISSION_TENANTS weights it.
+#
 # Usage: bash scripts/serve.sh <run_dir> [extra cli.serve flags...]
 # Env:   PORT (default 8000), BUCKETS (default auto), MAX_BATCH (16),
 #        BATCH_TIMEOUT_MS (5), TOPK (5), SERVE_DEVICES (0 = all),
-#        AOT_CACHE (auto | off | dir)
+#        AOT_CACHE (auto | off | dir),
+#        FLEET_DIR (off; shared fleet run dir), FLEET_REPLICA (0),
+#        FLEET_TTL_S (15), ADMISSION_DEADLINE_MS (0 = off),
+#        ADMISSION_TENANTS ("" = single default tenant)
 set -euo pipefail
 RUN_DIR=${1:?usage: bash scripts/serve.sh <run_dir> [flags...]}
 BUCKET_ARGS=()
 if [[ -n "${BUCKETS:-}" ]]; then
   BUCKET_ARGS=(--buckets "$BUCKETS")
+fi
+FLEET_ARGS=()
+if [[ -n "${FLEET_DIR:-}" ]]; then
+  FLEET_ARGS=(--fleet_dir "$FLEET_DIR"
+              --fleet_replica "${FLEET_REPLICA:-0}"
+              --fleet_ttl_s "${FLEET_TTL_S:-15}")
+fi
+if [[ -n "${ADMISSION_DEADLINE_MS:-}" ]]; then
+  FLEET_ARGS+=(--admission_deadline_ms "$ADMISSION_DEADLINE_MS")
+fi
+if [[ -n "${ADMISSION_TENANTS:-}" ]]; then
+  FLEET_ARGS+=(--admission_tenants "$ADMISSION_TENANTS")
 fi
 python -m ddp_classification_pytorch_tpu.cli.serve baseline \
   --watch "$RUN_DIR" \
@@ -34,4 +57,5 @@ python -m ddp_classification_pytorch_tpu.cli.serve baseline \
   --aot_cache "${AOT_CACHE:-auto}" \
   --out "$RUN_DIR/serve" \
   "${BUCKET_ARGS[@]}" \
+  ${FLEET_ARGS[@]+"${FLEET_ARGS[@]}"} \
   "${@:2}"

@@ -247,6 +247,9 @@ def test_registry_names_every_step_program():
                      # the dp-sharded serving predict (serve mesh assembles
                      # data-sharded global batches; docs/serving.md)
                      "topk_predict_serve_dp", "topk_predict_serve_dp_tp",
+                     # the fleet-width serve predict (dp4 — the autoscaler's
+                     # max-replica provisioning shape; docs/serving.md)
+                     "topk_predict_serve_fleet",
                      # the K-microbatch accumulated step (--grad_accum 4):
                      # lax.scan over microbatches, ONE deferred data-axis
                      # gradient reduction per optimizer step

@@ -318,6 +318,79 @@ def test_bench_serve_row_smoke_cpu():
     assert row["cold_start_ms"] > row["warm_start_ms"] > 0
 
 
+def test_serve_slo_metric_name_schema():
+    """Lock the SLO-search row's metric naming: the TPU capture must emit
+    exactly `resnet50_max_rps_at_p99_slo`, with the standard platform
+    suffix off-accel — same convention as the serve row."""
+    import bench
+
+    assert bench._serve_slo_metric_name("resnet50", True, "tpu") == \
+        "resnet50_max_rps_at_p99_slo"
+    assert bench._serve_slo_metric_name("resnet18", False, "cpu") == \
+        "resnet18_max_rps_at_p99_slo_cpu"
+
+
+def test_bench_cli_has_serve_slo_flags():
+    """The SLO-search surface must keep parsing (the smoke below drives
+    the row builder directly, so argparse drift would otherwise go
+    unseen)."""
+    p = subprocess.run([sys.executable, "bench.py", "--help"], cwd=REPO,
+                       capture_output=True, timeout=60)
+    assert p.returncode == 0, p.stderr[-300:]
+    helptext = p.stdout.decode()
+    for flag in ("--serve-slo-p99-ms", "--serve-slo-max-rps",
+                 "--serve-slo-iters"):
+        assert flag in helptext, flag
+
+
+def test_bench_serve_slo_row_smoke_cpu():
+    """Run the closed-loop SLO search (the exact `_bench_serve_slo_row`
+    that `bench.py --serve --serve-slo-p99-ms N` calls) on the CPU backend
+    with a tiny model, and lock the emitted row's schema. The reported
+    value must be a KNOWN-GOOD floor: either 0 (nothing held the SLO) or
+    an rps some probe actually sustained — never an extrapolation — and
+    the probe ladder must ride along as evidence."""
+    import bench
+    from ddp_classification_pytorch_tpu.config import get_preset
+    from ddp_classification_pytorch_tpu.parallel import mesh as meshlib
+
+    cfg = get_preset("baseline")
+    cfg.model.arch = "resnet18"
+    cfg.model.variant = "cifar"
+    cfg.model.dtype = "float32"
+    cfg.data.num_classes = 8
+    cfg.data.image_size = 16
+    mesh = meshlib.serve_mesh(2)
+    row = bench._bench_serve_slo_row(
+        cfg, mesh,
+        metric=bench._serve_slo_metric_name("resnet18", False, "cpu"),
+        slo_p99_ms=60_000.0,  # generous: CPU smoke proves schema, not perf
+        max_rps=32.0, iters=2, n_requests=6,
+        buckets=(2, 4), max_batch=4, timeout_ms=5.0, topk=3)
+
+    assert row["metric"] == "resnet18_max_rps_at_p99_slo_cpu"
+    assert row["unit"] == "rps"
+    assert row["p99_slo_ms"] == 60_000.0
+    assert row["slo_bound_rps"] == 32.0
+    assert row["n_requests_per_probe"] == 6
+    assert row["buckets"] == [2, 4] and row["topk"] == 3
+    assert row["serve_devices"] >= 1
+    # the probe ladder: every probe carries (rps, p99_ms, ok), the first
+    # one is the ceiling probe at max_rps
+    assert row["probes"], "no probes recorded"
+    assert row["probes"][0]["rps"] == 32.0
+    for p_ in row["probes"]:
+        assert set(p_) == {"rps", "p99_ms", "ok"}
+        assert p_["p99_ms"] > 0
+    # value is the highest KNOWN-GOOD rps: it must equal some passing
+    # probe's rps (or 0.0 when none passed), and with a 60s SLO on 6
+    # requests the ceiling probe passes → bound-limited at max_rps
+    passing = [p_["rps"] for p_ in row["probes"] if p_["ok"]]
+    assert row["value"] == (max(passing) if passing else 0.0)
+    assert row["bound_limited"] is True and row["value"] == 32.0
+    assert row["p99_at_max_ms"] > 0
+
+
 def test_watchdog_disarm_prevents_exit():
     src = (
         "import time, bench\n"

@@ -23,7 +23,9 @@ from ddp_classification_pytorch_tpu.scenario.invariants import (
     check_s2_availability,
     check_s3_adoption,
     check_s4_analyzer,
+    check_s5_fleet,
     good_publishes,
+    replica_retire_times,
 )
 from ddp_classification_pytorch_tpu.scenario.spec import SpecError, load_spec
 from ddp_classification_pytorch_tpu.utils.chaos import FaultPlan
@@ -79,10 +81,39 @@ def test_spec_defaults_and_full_parse(tmp_path):
     '{"timeline": [{"at": "epoch:1", "action": "drain_replica"}]}',
     '{"timeline": [{"at": "t:1", "action": "explode"}]}',
     '{"timeline": [{"at": "t:1", "action": "drain_replica", "replica": 7}]}',
+    '{"serve": {"replicas": 2, "max_replicas": 1}}',  # cap below floor
+    '{"serve": {"fleet_ttl_s": 0}}',                  # dead-on-arrival leases
+    '{"serve": {"admission_deadline_ms": -1}}',       # negative deadline
+    '{"serve": {"scale_out_deadline_s": 0}}',         # zero SLA
+    '{"timeline": [{"at": "t:1", "action": "spike_load"}]}',       # no rps
+    '{"timeline": [{"at": "t:1", "action": "spike_load", "rps": 0}]}',
+    '{"timeline": [{"at": "t:1", "action": "spike_load", "rps": "x"}]}',
+    '{"timeline": [{"at": "publish:1", "action": "spike_load", "rps": 2}]}',
+    '{"timeline": [{"at": "t:1", "action": "spike_load", "rps": 2, '
+    '"replica": 0}]}',
+    '{"timeline": [{"at": "t:1", "action": "kill_replica", "rps": 2}]}',
+    '{"timeline": [{"at": "t:1", "action": "kill_replica_during_wave", '
+    '"replica": 1}]}',
 ])
 def test_spec_errors(bad):
     with pytest.raises(SpecError):
         load_spec(bad)
+
+
+def test_spec_fleet_keys_and_new_actions_parse():
+    s = load_spec(json.dumps({
+        "serve": {"replicas": 2, "max_replicas": 3, "fleet_ttl_s": 2.5,
+                  "admission_deadline_ms": 250.0,
+                  "scale_out_deadline_s": 30.0},
+        "timeline": [{"at": "t:30", "action": "spike_load", "rps": 12},
+                     {"at": "t:40", "action": "kill_replica_during_wave"}],
+    }))
+    assert s.serve.max_replicas == 3
+    assert s.serve.fleet_ttl_s == 2.5
+    assert s.serve.admission_deadline_ms == 250.0
+    assert s.serve.scale_out_deadline_s == 30.0
+    assert str(s.timeline[0]) == "spike_load@t:30(rps=12.0)"
+    assert str(s.timeline[1]) == "kill_replica_during_wave@t:40(holder)"
 
 
 def test_cli_scenario_bad_spec_exits_2(capsys):
@@ -376,6 +407,118 @@ def test_s4_fires_on_missing_or_red_lint():
     assert any("no lint event" in v.message for v in check_s4_analyzer(E))
     E.append({"ts": 30.0, "kind": "lint", "source": "supervisor", "rc": 1})
     assert any("rc=1" in v.message for v in check_s4_analyzer(E))
+
+
+def _fleet_spec():
+    return load_spec(
+        '{"serve": {"replicas": 2, "max_replicas": 3, '
+        '"scale_out_deadline_s": 30.0}, '
+        '"availability": {"floor": 0.5, "window_s": 10.0, "min_samples": 3},'
+        ' "adopt_deadline_s": 20}')
+
+
+def test_s5_passes_on_serialized_wave_and_on_no_fleet_events():
+    assert check_s5_fleet(_clean_timeline(), _spec()) == []  # vacuous
+    E = _clean_timeline()
+    E += [{"ts": 40.0, "kind": "drain_token_acquire", "source": "replica0",
+           "replica": 0, "digest": "D0"},
+          {"ts": 41.0, "kind": "drain_token_release", "source": "replica0",
+           "replica": 0, "digest": "D0", "generation": 0},
+          {"ts": 42.0, "kind": "drain_token_acquire", "source": "replica1",
+           "replica": 1, "digest": "D0"},
+          {"ts": 43.0, "kind": "drain_token_release", "source": "replica1",
+           "replica": 1, "digest": "D0", "generation": 0}]
+    assert check_s5_fleet(sorted(E, key=lambda r: r["ts"]), _spec()) == []
+
+
+def test_s5_fires_on_overlapping_drains():
+    E = _clean_timeline()
+    E += [{"ts": 40.0, "kind": "drain_token_acquire", "source": "replica0",
+           "replica": 0, "digest": "D0"},
+          {"ts": 41.0, "kind": "drain_token_acquire", "source": "replica1",
+           "replica": 1, "digest": "D0"}]
+    v = check_s5_fleet(sorted(E, key=lambda r: r["ts"]), _spec())
+    assert len(v) == 1 and v[0].invariant == "S5"
+    assert "two replicas draining at once" in v[0].message
+
+
+def test_s5_takeover_closes_the_wedged_holders_interval():
+    E = _clean_timeline()
+    # replica0 acquires then dies without releasing; replica1's TTL
+    # takeover force-closes the interval, so its acquire is NOT an overlap
+    E += [{"ts": 40.0, "kind": "drain_token_acquire", "source": "replica0",
+           "replica": 0, "digest": "D0"},
+          {"ts": 50.0, "kind": "drain_token_takeover", "source": "replica1",
+           "replica": 1, "digest": "D0"},
+          {"ts": 50.1, "kind": "drain_token_acquire", "source": "replica1",
+           "replica": 1, "digest": "D0"},
+          {"ts": 51.0, "kind": "drain_token_release", "source": "replica1",
+           "replica": 1, "digest": "D0", "generation": 0}]
+    assert check_s5_fleet(sorted(E, key=lambda r: r["ts"]), _spec()) == []
+
+
+def test_s5_fires_on_survivor_digest_divergence():
+    E = _clean_timeline()
+    E.append({"ts": 25.0, "kind": "swap", "source": "replica1", "epoch": 0,
+              "digest": "DX"})
+    v = check_s5_fleet(sorted(E, key=lambda r: r["ts"]), _spec())
+    assert any("did not converge" in x.message for x in v)
+    # ...unless that replica was retired by scale-in: survivors only
+    E.append({"ts": 26.0, "kind": "replica_retire", "source": "supervisor",
+              "replica": "replica1"})
+    assert check_s5_fleet(sorted(E, key=lambda r: r["ts"]), _spec()) == []
+    assert replica_retire_times(E) == {"replica1": 26.0}
+
+
+def test_s5_fires_on_convergence_to_a_stale_digest():
+    E = _clean_timeline()
+    for r in ("replica0", "replica1"):  # both end on a digest that is not
+        E.append({"ts": 25.0, "kind": "swap", "source": r, "epoch": 0,
+                  "digest": "STALE"})  # the newest good publish (D0)
+    v = check_s5_fleet(sorted(E, key=lambda r: r["ts"]), _spec())
+    assert len(v) == 1 and "newest good publish" in v[0].message
+
+
+def test_s5_spike_load_demands_scale_out_within_deadline():
+    E = _clean_timeline()
+    E.append({"ts": 40.0, "kind": "spike_load", "source": "supervisor",
+              "rps": 10.0})
+    # scaler disarmed (max_replicas == 0): no demand on the timeline
+    assert not any("spike_load" in x.message
+                   for x in check_s5_fleet(E, _spec()))
+    # armed spec: the unanswered spike is a violation...
+    v = check_s5_fleet(sorted(E, key=lambda r: r["ts"]), _fleet_spec())
+    assert any("never answered by a" in x.message for x in v)
+    # ...a scale_out past the deadline still is...
+    late = E + [{"ts": 75.0, "kind": "scale_out", "source": "supervisor",
+                 "replica": "replica2", "replicas": 3}]
+    v = check_s5_fleet(sorted(late, key=lambda r: r["ts"]), _fleet_spec())
+    assert any("never answered by a" in x.message for x in v)
+    # ...and one inside it settles the demand
+    ok = E + [{"ts": 55.0, "kind": "scale_out", "source": "supervisor",
+               "replica": "replica2", "replicas": 3}]
+    assert check_s5_fleet(sorted(ok, key=lambda r: r["ts"]),
+                          _fleet_spec()) == []
+
+
+def test_s3_scale_in_retirement_excuses_adoption():
+    E = _clean_timeline()
+    E.append({"ts": 25.0, "kind": "publish", "source": "trainer.h0",
+              "epoch": 2, "path": "c2", "digest": "D2", "world_size": 1})
+    E.append({"ts": 26.0, "kind": "swap", "source": "replica0", "epoch": 2,
+              "digest": "D2"})
+    # without the retirement record, replica1 is a plain S3 red
+    v = check_s3_adoption(sorted(E, key=lambda r: r["ts"]), _spec())
+    assert len(v) == 1 and "replica1" in v[0].message
+    # retired before its deadline and never came back: excused
+    E.append({"ts": 30.0, "kind": "replica_retire", "source": "supervisor",
+              "replica": "replica1"})
+    assert check_s3_adoption(sorted(E, key=lambda r: r["ts"]), _spec()) == []
+    # a serve_ready AFTER the retirement voids the excusal (it rejoined)
+    E.append({"ts": 35.0, "kind": "serve_ready", "source": "replica1",
+              "port": 1, "epoch": 2})
+    v = check_s3_adoption(sorted(E, key=lambda r: r["ts"]), _spec())
+    assert len(v) == 1 and "replica1" in v[0].message
 
 
 def test_cli_scenario_check_only_red_and_green(tmp_path, capsys):

@@ -271,3 +271,35 @@ def test_serve_dp_aot_knobs_locked():
     for needle in ("cold_start_ms", "aot_cache_hit"):
         assert needle in body, \
             f"worklist lost its {needle!r} warm-path verification"
+
+
+def test_serve_fleet_admission_knobs_locked():
+    """The serve-fleet control-plane knobs must stay addressable in both
+    spellings on cli.serve (scripts use underscores, operators type
+    hyphens), scripts/serve.sh must keep its env→flag plumbing for them,
+    and chaos_drill.sh phase 9 must keep asserting the fleet evidence it
+    exists to prove (drain token, load spike, autoscale answer, the S5
+    verdict line) — drop any of these and the rolling-wave/SLO story
+    silently stops being exercised."""
+    from ddp_classification_pytorch_tpu.cli.serve import build_parser
+
+    known = set()
+    for action in build_parser()._actions:
+        known.update(action.option_strings)
+    for flag in ("--fleet_dir", "--fleet-dir",
+                 "--fleet_replica", "--fleet-replica",
+                 "--fleet_ttl_s", "--fleet-ttl-s",
+                 "--admission_deadline_ms", "--admission-deadline-ms",
+                 "--admission_tenants", "--admission-tenants"):
+        assert flag in known, f"cli.serve lost {flag}"
+    body = _script_body("serve.sh")
+    for knob in ("FLEET_DIR", "FLEET_REPLICA", "FLEET_TTL_S",
+                 "ADMISSION_DEADLINE_MS", "ADMISSION_TENANTS"):
+        assert knob in body, f"serve.sh lost its {knob} env knob"
+    drill = _script_body("chaos_drill.sh")
+    for needle in ('"kind": "drain_token_acquire"', '"kind": "spike_load"',
+                   '"kind": "scale_out"', "kill_replica_during_wave",
+                   "S5 fleet", "max_replicas", "fleet_ttl_s",
+                   "admission_deadline_ms", "scale_out_deadline_s"):
+        assert needle in drill, \
+            f"chaos_drill.sh lost its {needle!r} fleet-drill piece"
