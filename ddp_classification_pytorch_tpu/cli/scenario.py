@@ -19,7 +19,10 @@ rc discipline (registered in analysis/lint.py's 0–11 catalogue):
 - **1** — an invariant was violated, or a supervised process failed
   (trainer rc != 0 through its restart budget, replica drain broke,
   analyzer gate red);
-- **2** — malformed `--scenario_spec` (deterministic; never retried).
+- **2** — malformed `--scenario_spec`, or (under `--check_only`) an
+  events file with unknown event kinds / missing required fields
+  (deterministic; never retried). A fuzzer replaying corrupt forensics
+  must fail loudly, not pass vacuously.
 """
 
 from __future__ import annotations
@@ -48,7 +51,9 @@ def build_parser() -> argparse.ArgumentParser:
                         "with --check_only, the timeline to re-check")
     p.add_argument("--check_only", action="store_true",
                    help="skip the run: replay an existing events file "
-                        "through the S1–S4 checkers only")
+                        "through the invariant checkers only; the file "
+                        "is schema-validated (unknown kinds / missing "
+                        "fields exit rc 2)")
     p.add_argument("--skip_lint", action="store_true",
                    help="skip the end-of-run analyzer gate (lint.sh) and "
                         "the S4 check — for quick iteration, not CI")
@@ -79,7 +84,7 @@ def main(argv: Optional[Sequence[str]] = None) -> None:
         for f in sup.failures:
             print(f"[scenario] FAIL: {f}", file=sys.stderr)
 
-    from ..obs.events import read_events
+    from ..obs.events import read_events, validate_events
     from ..scenario.invariants import check_invariants
 
     events = read_events(events_path)
@@ -87,6 +92,17 @@ def main(argv: Optional[Sequence[str]] = None) -> None:
         print(f"[scenario] no events at {events_path} — nothing to check",
               file=sys.stderr)
         raise SystemExit(1)
+    if args.check_only:
+        # a replayed timeline is committed forensics: unknown kinds or
+        # missing fields mean the checkers would run on half-evidence
+        # and pass vacuously — deterministic rc 2, same as a bad spec
+        schema_errors = validate_events(events)
+        if schema_errors:
+            for err in schema_errors[:10]:
+                print(f"[scenario] events error: {err}", file=sys.stderr)
+            print(f"[scenario] events error: {len(schema_errors)} schema "
+                  f"error(s) in {events_path}", file=sys.stderr)
+            raise SystemExit(2)
     restarts = os.path.join(args.out, "restarts.log")
     violations = check_invariants(
         events, spec,

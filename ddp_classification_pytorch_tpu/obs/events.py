@@ -72,6 +72,62 @@ from typing import Any, Dict, List
 ENV_EVENTS = "SCENARIO_EVENTS"
 ENV_SOURCE = "SCENARIO_SOURCE"
 
+# The event vocabulary, machine-readable: kind → required fields beyond
+# the ts/kind/source envelope (the fields the S1–S5 checkers and the
+# fuzz replayer actually read; producers may append extras freely).
+# `cli.scenario --check_only` validates a replayed timeline against this
+# so a corrupt forensics file fails loudly (rc 2) instead of vacuously
+# passing with its evidence silently skipped.
+EVENT_SCHEMA: Dict[str, tuple] = {
+    "scenario_start": (),
+    "scenario_end": (),
+    "publish": ("epoch", "path", "digest"),
+    "publish_torn": ("epoch", "path"),
+    "quarantine": ("path",),
+    "verify_ok": ("epoch", "path", "digest"),
+    "swap": ("epoch", "digest"),
+    "watcher_error": ("error", "poll"),
+    "serve_ready": ("port",),
+    "drain_begin": (),
+    "drain_end": (),
+    "reform": ("gen", "world"),
+    "replica_start": ("replica", "port"),
+    "replica_stop": ("replica", "rc"),
+    "request": ("status", "replica"),
+    "lint": ("rc",),
+    "timeline": ("action",),
+    "spike_load": ("rps",),
+    "host_lost_observed": ("host",),
+    "host_relaunch": ("host",),
+    "drain_token_acquire": ("replica",),
+    "drain_token_release": ("replica",),
+    "drain_token_takeover": ("replica",),
+    "admission_shed": ("tenant",),
+    "scale_out": ("replica", "replicas"),
+    "scale_in": ("replica", "replicas"),
+    "replica_retire": ("replica",),
+}
+
+
+def validate_events(events: List[Dict]) -> List[str]:
+    """Schema errors for a replayed timeline: unknown kinds and missing
+    required fields (per ``EVENT_SCHEMA``), plus a missing ts/source
+    envelope. Empty list = clean. Live runs stay tolerant (a hole is
+    missing evidence, not a crash); replays of committed forensics must
+    not be — a checker fed a half-vocabulary timeline proves nothing."""
+    errors: List[str] = []
+    for i, rec in enumerate(events):
+        kind = rec.get("kind")
+        if kind not in EVENT_SCHEMA:
+            errors.append(f"event[{i}]: unknown kind {kind!r}")
+            continue
+        missing = [f for f in ("ts", "source") + EVENT_SCHEMA[kind]
+                   if f not in rec]
+        if missing:
+            errors.append(f"event[{i}] kind={kind}: missing "
+                          f"required field(s) {missing}")
+    return errors
+
 
 class EventLog:
     """Explicit-path appender for processes that own their identity (the

@@ -7,15 +7,20 @@ CheckpointWatcher) sustain offered HTTP load, a declarative chaos timeline
 injects train- AND serve-side faults, and every observable transition —
 publish, verify, quarantine, swap, 503, re-form generation bump — lands in
 one machine-readable `events.jsonl`. The invariant checker replays that
-timeline and asserts the four production contracts (S1 verified-serve,
-S2 availability floor, S3 bounded adoption, S4 analyzer still green).
+timeline and asserts the five production contracts (S1 verified-serve,
+S2 availability floor, S3 bounded adoption, S4 analyzer still green,
+S5 fleet: wave exclusivity / survivor convergence / spike elasticity).
 
 Submodules (all stdlib-only — the supervisor shells out to the real
 trainer/server processes instead of importing their jax stacks):
 
 - `events`     — append-only JSONL event log + the env-gated `emit()`
                  hook the serve/train/fleet code calls;
-- `spec`       — the `--scenario_spec` JSON grammar + validation (rc 2);
-- `invariants` — S1–S4 checkers over a parsed event timeline;
-- `supervisor` — the process orchestrator behind `cli.scenario`.
+- `spec`       — the `--scenario_spec` JSON grammar + validation (rc 2),
+                 with a lossless `ScenarioSpec.to_json` round-trip;
+- `invariants` — S1–S5 checkers over a parsed event timeline;
+- `supervisor` — the process orchestrator behind `cli.scenario`;
+- `fuzz`       — coverage-steered property-based search over the fault
+                 space with a delta-minimizing shrinker (`cli.fuzz`);
+                 minimized finds live in `tests/data/scenarios/`.
 """

@@ -15,8 +15,10 @@ The contracts (docs/operations.md has the operator-facing wording):
   Connection-refused and timeouts count against the floor — a dead
   socket is not backpressure. Windows with fewer than ``min_samples``
   attempts are skipped (one unlucky probe is not an outage).
-- **S3 bounded adoption** — every *good* publish (no ``publish_torn``,
-  never ``quarantine``\\ d) must be followed, on every replica, by a
+- **S3 bounded adoption** — every *good* publish (its write neither
+  torn nor quarantined; condemnation is per write, so a clean
+  re-publish of a once-torn path is a fresh candidate) must be
+  followed, on every replica, by a
   ``swap`` of that epoch or newer within ``adopt_deadline_s``; a replica
   that restarts (new ``serve_ready``) gets its deadline re-based so a
   deliberate drain/relaunch in the timeline is not an instant red.
@@ -37,7 +39,9 @@ The contracts (docs/operations.md has the operator-facing wording):
   lands on ONE digest, and it is the digest of the newest good publish;
   (c) *scale-out deadline*: when the spec arms the autoscaler
   (``max_replicas > replicas``), every ``spike_load`` must be answered
-  by a ``scale_out`` within ``scale_out_deadline_s``. A timeline with
+  by a ``scale_out`` within ``scale_out_deadline_s`` — unless the fleet
+  already sits at ``max_replicas`` when the spike lands (there is
+  nothing left to scale into). A timeline with
   no fleet events passes vacuously (pre-fleet runs stay checkable).
   S3 composes with retirement: a ``replica_retire``\\ d replica is
   excused from publishes whose adoption deadline falls after it left
@@ -151,15 +155,29 @@ def check_s2_availability(events: Sequence[Dict],
 
 def good_publishes(events: Sequence[Dict]) -> List[Dict]:
     """publish events whose candidate was neither torn at write time nor
-    later quarantined by any verifier."""
-    torn_paths = {e.get("path") for e in events
-                  if e.get("kind") == "publish_torn"}
-    quarantined = {e.get("path") for e in events
-                   if e.get("kind") == "quarantine"}
-    return [e for e in events
-            if e.get("kind") == "publish"
-            and e.get("path") not in torn_paths
-            and e.get("path") not in quarantined]
+    later quarantined by any verifier.
+
+    Condemnation is per-WRITE, not per-path-forever: a ``publish_torn``
+    or ``quarantine`` marks only the most recent preceding ``publish``
+    of that path bad, so a clean RE-publish of the same path (a
+    restarted trainer resuming past a quarantined epoch re-writes it)
+    is a fresh candidate the fleet must adopt. The old path-forever set
+    silently excused every later write of a once-torn path from the S3
+    adoption and S5(b) convergence contracts — found while building the
+    scenario fuzzer's simulator (torn-then-republish shape)."""
+    latest: Dict[str, int] = {}  # path -> index of its most recent publish
+    bad: set = set()             # indices of condemned publish events
+    pubs: List = []              # (index, event), in timeline order
+    for i, e in enumerate(events):
+        kind = e.get("kind")
+        if kind == "publish":
+            latest[str(e.get("path"))] = i
+            pubs.append((i, e))
+        elif kind in ("publish_torn", "quarantine"):
+            j = latest.get(str(e.get("path")))
+            if j is not None:
+                bad.add(j)
+    return [e for i, e in pubs if i not in bad]
 
 
 def replica_retire_times(events: Sequence[Dict]) -> Dict[str, float]:
@@ -301,10 +319,21 @@ def check_s5_fleet(events: Sequence[Dict],
     if spec.serve.max_replicas > spec.serve.replicas:
         scale_ts = [float(e.get("ts", 0.0)) for e in events
                     if e.get("kind") == "scale_out"]
+        scale_in_ts = [float(e.get("ts", 0.0)) for e in events
+                       if e.get("kind") == "scale_in"]
         for e in events:
             if e.get("kind") != "spike_load":
                 continue
             t_spike = float(e.get("ts", 0.0))
+            # a spike landing when the fleet already sits at max_replicas
+            # has nothing left to scale into — demanding a scale_out here
+            # was a false red (fuzzer-found; regression:
+            # tests/data/scenarios/spike-at-max-fleet)
+            fleet_now = (spec.serve.replicas
+                         + sum(1 for t in scale_ts if t <= t_spike)
+                         - sum(1 for t in scale_in_ts if t <= t_spike))
+            if fleet_now >= spec.serve.max_replicas:
+                continue
             limit = t_spike + spec.serve.scale_out_deadline_s
             if not any(t_spike <= t <= limit for t in scale_ts):
                 out.append(Violation(

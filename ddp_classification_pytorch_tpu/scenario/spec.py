@@ -145,6 +145,14 @@ class ScenarioSpec:
     deadline_s: float = 600.0
     timeline: List[TimelineItem] = field(default_factory=list)
 
+    def to_json(self, indent: int = 2) -> str:
+        """Canonical lossless dump: ``parse_spec(json.loads(s.to_json()))
+        == s`` and the dump is a fixpoint (dump → parse → dump is
+        byte-identical), so a minimized failing spec can be committed
+        under tests/data/scenarios/ verbatim and replayed forever."""
+        return json.dumps(spec_to_raw(self), indent=indent,
+                          sort_keys=True) + "\n"
+
 
 def _typed(section: str, raw: dict, key: str, kind, default):
     v = raw.get(key, default)
@@ -356,6 +364,56 @@ def parse_spec(raw: dict) -> ScenarioSpec:
     return ScenarioSpec(trainer=trainer, serve=serve, load=load,
                         availability=avail, adopt_deadline_s=float(adopt),
                         deadline_s=float(deadline), timeline=items)
+
+
+def _timeline_item_raw(item: TimelineItem) -> dict:
+    """The dump half of the timeline grammar, action-aware to mirror the
+    parser exactly: ``spike_load`` carries ``rps`` and no ``replica``
+    (the parser rejects one), ``kill_replica_during_wave`` carries
+    neither (it targets the token holder), everything else carries
+    ``replica`` and no ``rps``. A naive field dump of TimelineItem would
+    round-trip to an rc 2 here — this asymmetry is exactly the "field
+    the dump path reveals as unparseable"."""
+    raw = {"at": f"{item.at_kind}:{item.at_value}", "action": item.action}
+    if item.action == "spike_load":
+        raw["rps"] = item.rps
+    elif item.action != "kill_replica_during_wave":
+        raw["replica"] = item.replica
+    return raw
+
+
+def spec_to_raw(spec: ScenarioSpec) -> dict:
+    """ScenarioSpec → the raw dict `parse_spec` accepts. Every field is
+    emitted explicitly (defaults included) so the dump is canonical:
+    two equal specs always serialize byte-identically."""
+    t, s = spec.trainer, spec.serve
+    return {
+        "trainer": {
+            "hosts": t.hosts, "elastic": t.elastic,
+            "min_processes": t.min_processes, "epochs": t.epochs,
+            "model": t.model, "variant": t.variant,
+            "num_classes": t.num_classes, "image_size": t.image_size,
+            "batchsize": t.batchsize, "synthetic_size": t.synthetic_size,
+            "relaunch_lost": t.relaunch_lost,
+            "fault_specs": {str(k): v for k, v in sorted(t.fault_specs.items())},
+        },
+        "serve": {
+            "replicas": s.replicas, "poll_s": s.poll_s,
+            "queue_depth": s.queue_depth, "max_batch": s.max_batch,
+            "buckets": s.buckets, "max_replicas": s.max_replicas,
+            "fleet_ttl_s": s.fleet_ttl_s,
+            "admission_deadline_ms": s.admission_deadline_ms,
+            "scale_out_deadline_s": s.scale_out_deadline_s,
+            "fault_specs": {str(k): v for k, v in sorted(s.fault_specs.items())},
+        },
+        "load": {"rps": spec.load.rps, "timeout_s": spec.load.timeout_s},
+        "availability": {"floor": spec.availability.floor,
+                         "window_s": spec.availability.window_s,
+                         "min_samples": spec.availability.min_samples},
+        "adopt_deadline_s": spec.adopt_deadline_s,
+        "deadline_s": spec.deadline_s,
+        "timeline": [_timeline_item_raw(it) for it in spec.timeline],
+    }
 
 
 def load_spec(spec_arg: str) -> ScenarioSpec:

@@ -72,9 +72,64 @@ import sys
 from dataclasses import dataclass
 from typing import List, Optional, Tuple
 
-KINDS = ("nan_loss", "ckpt_io", "loader_io", "sigterm", "peer_dead",
-         "peer_slow", "host_lost", "publish_corrupt", "watcher_io")
+@dataclass(frozen=True)
+class KindInfo:
+    """One row of the fault grammar: which range units a kind accepts,
+    which side of the train→serve pipeline injects it, which subsystem
+    is expected to absorb it, and the error to raise on a wrong unit.
+    The scenario fuzzer enumerates this table instead of hardcoding
+    kinds, so a new fault automatically enters the search space."""
+
+    units: Tuple[str, ...]  # allowed range units, first = canonical
+    side: str  # "trainer" | "serve": who hosts the injection hook
+    subsystem: str  # the recovery layer under test
+    unit_error: str = ""  # parse error when the unit is not allowed
+
+
+# kind → grammar row. Subsystem names feed the fuzzer's coverage ledger
+# keys ("<kind>x<subsystem>"); keep them stable.
+FAULT_GRAMMAR = {
+    "nan_loss": KindInfo(
+        ("step",), "trainer", "sentinel",
+        "nan_loss is keyed by the in-jit step counter; use nan_loss@step=..."),
+    "ckpt_io": KindInfo(("epoch", "step", "batch"), "trainer", "checkpoint"),
+    "loader_io": KindInfo(("batch", "epoch", "step"), "trainer", "dataplane"),
+    "sigterm": KindInfo(("step", "epoch", "batch"), "trainer", "supervise"),
+    "peer_dead": KindInfo(
+        ("step",), "trainer", "pod",
+        "peer_dead is keyed by the host-side step counter; "
+        "use peer_dead@step=..."),
+    "peer_slow": KindInfo(
+        ("step",), "trainer", "pod",
+        "peer_slow is keyed by the host-side step counter; "
+        "use peer_slow@step=..."),
+    "host_lost": KindInfo(
+        ("step",), "trainer", "elastic",
+        "host_lost is keyed by the host-side step counter; "
+        "use host_lost@step=..."),
+    "publish_corrupt": KindInfo(
+        ("epoch",), "trainer", "publish",
+        "publish_corrupt tears a published epoch checkpoint; "
+        "use publish_corrupt@epoch=..."),
+    "watcher_io": KindInfo(
+        ("poll",), "serve", "watcher",
+        "watcher_io is keyed by the watcher's poll counter; "
+        "use watcher_io@poll=..."),
+}
+
+KINDS = tuple(FAULT_GRAMMAR)
 UNITS = ("step", "epoch", "batch", "poll")
+
+
+def kinds_for_side(side: str) -> Tuple[str, ...]:
+    """Fault kinds whose injection hook lives on `side` ("trainer" or
+    "serve") — the fuzzer's per-subsystem sampling universe."""
+    return tuple(k for k, info in FAULT_GRAMMAR.items() if info.side == side)
+
+
+def subsystem_of(kind: str) -> str:
+    """The recovery subsystem a fault kind targets (coverage-ledger axis)."""
+    return FAULT_GRAMMAR[kind].subsystem
 
 ENV_SPEC = "CHAOS_FAULT_SPEC"
 ENV_STATE_DIR = "CHAOS_STATE_DIR"
@@ -163,22 +218,15 @@ class FaultPlan:
                     f"malformed fault {part!r} (want kind@unit=N, "
                     "kind@unit=N..M, or kind@unit=N..)") from None
             kind, unit = kind.strip(), unit.strip()
-            if kind not in KINDS:
+            if kind not in FAULT_GRAMMAR:
                 raise ValueError(f"unknown fault kind {kind!r}; one of {KINDS}")
             if unit not in UNITS:
                 raise ValueError(f"unknown fault unit {unit!r}; one of {UNITS}")
-            if kind == "nan_loss" and unit != "step":
-                raise ValueError("nan_loss is keyed by the in-jit step "
-                                 "counter; use nan_loss@step=...")
-            if kind in ("peer_dead", "peer_slow", "host_lost") and unit != "step":
-                raise ValueError(f"{kind} is keyed by the host-side step "
-                                 f"counter; use {kind}@step=...")
-            if kind == "publish_corrupt" and unit != "epoch":
-                raise ValueError("publish_corrupt tears a published epoch "
-                                 "checkpoint; use publish_corrupt@epoch=...")
-            if kind == "watcher_io" and unit != "poll":
-                raise ValueError("watcher_io is keyed by the watcher's poll "
-                                 "counter; use watcher_io@poll=...")
+            info = FAULT_GRAMMAR[kind]
+            if unit not in info.units:
+                raise ValueError(
+                    info.unit_error
+                    or f"{kind} accepts units {info.units}; got {unit!r}")
             if unit == "poll" and kind != "watcher_io":
                 raise ValueError("the poll unit belongs to watcher_io only")
             faults.append(Fault(kind, unit, lo, hi))

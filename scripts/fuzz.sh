@@ -1,0 +1,30 @@
+#!/usr/bin/env bash
+# Scenario fuzzing — a bounded, seeded property-based search over the
+# chaos fault space (scenario/fuzz.py; runbook: docs/operations.md
+# "Fuzzing runbook").
+#
+# Samples random valid scenario specs (fault kinds x timing x topology x
+# timeline actions), steered by the persistent coverage ledger toward
+# uncovered (fault kind x subsystem) pairs, runs each through the fast
+# correct-behavior simulator + S1-S5 checkers, and on any violation
+# delta-minimizes the spec to its smallest failing form under
+# $OUT/minimized/ — ready to promote into tests/data/scenarios/.
+# Exits with cli.fuzz's code: 0 green, 1 minimized failure found,
+# 2 bad args.
+#
+#   bash scripts/fuzz.sh                       # seeded default budget
+#   FUZZ_SEED=7 FUZZ_BUDGET=200 bash scripts/fuzz.sh runs/fuzz-nightly
+#   FUZZ_RUNNER=drill FUZZ_BUDGET=3 bash scripts/fuzz.sh   # real drills
+#
+# Flags used here are locked against the cli.fuzz parser by
+# tests/test_scripts_meta.py.
+set -u
+REPO=$(cd "$(dirname "$0")/.." && pwd)
+OUT=${1:-"$REPO/runs/fuzz"}
+SEED=${FUZZ_SEED:-0}
+BUDGET=${FUZZ_BUDGET:-50}
+RUNNER=${FUZZ_RUNNER:-sim}
+
+cd "$REPO"
+exec env JAX_PLATFORMS=cpu python -m ddp_classification_pytorch_tpu.cli.fuzz \
+    --seed "$SEED" --budget "$BUDGET" --runner "$RUNNER" --out "$OUT"

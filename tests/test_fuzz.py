@@ -1,0 +1,398 @@
+"""Scenario fuzzer: sampler determinism, spec round-trip, coverage
+steering, the delta-minimizing shrinker, and the committed regression
+corpus (tests/data/scenarios/ replayed through `cli.scenario
+--check_only`).
+
+Everything except the `slow` smoke at the bottom is tier-1-lean: pure
+in-process stdlib (the sampler, simulator, shrinker and checkers spawn
+no subprocesses and never sleep)."""
+
+import json
+import os
+
+import pytest
+
+from ddp_classification_pytorch_tpu.scenario import fuzz as fuzzlib
+from ddp_classification_pytorch_tpu.scenario.fuzz import (
+    CoverageLedger, DrillRunner, Fuzzer, SpecSampler, coverage_keys,
+    pair_universe, shrink_spec, sim_runner, simulate_events)
+from ddp_classification_pytorch_tpu.scenario.invariants import (
+    Violation, check_invariants)
+from ddp_classification_pytorch_tpu.scenario.spec import (
+    ScenarioSpec, parse_spec, spec_to_raw)
+
+DATA = os.path.join(os.path.dirname(__file__), "data", "scenarios")
+
+
+# ------------------------------------------------------------- round-trip --
+
+def test_to_json_round_trip_handcrafted():
+    """parse → dump → parse identity, including the action-aware timeline
+    asymmetry: spike_load carries rps and no replica, wave-kill carries
+    neither — a naive field dump would re-parse to rc 2."""
+    raw = {
+        "trainer": {"hosts": 2,
+                    "fault_specs": {"0": "ckpt_io@epoch=0",
+                                    "1": "nan_loss@step=2..3"}},
+        "serve": {"replicas": 2, "max_replicas": 3,
+                  "fault_specs": {"1": "watcher_io@poll=4"}},
+        "timeline": [
+            {"at": "publish:1", "action": "drain_replica", "replica": 1},
+            {"at": "t:30", "action": "spike_load", "rps": 12.0},
+            {"at": "t:40", "action": "kill_replica_during_wave"},
+        ],
+    }
+    spec = parse_spec(raw)
+    dumped = spec.to_json()
+    again = parse_spec(json.loads(dumped))
+    assert again == spec
+    assert again.to_json() == dumped  # dump is a fixpoint
+
+
+def test_to_json_round_trip_property_over_generated_specs():
+    """The satellite contract, property-tested over the sampler: every
+    generated spec survives parse → dump → parse byte-identically."""
+    sampler = SpecSampler(seed=11, candidates=1)
+    for _ in range(25):
+        spec = sampler.sample()
+        dumped = spec.to_json()
+        again = parse_spec(json.loads(dumped))
+        assert again == spec
+        assert again.to_json() == dumped
+
+
+def test_sampler_same_seed_byte_identical_sequence():
+    a = SpecSampler(seed=7, candidates=3)
+    b = SpecSampler(seed=7, candidates=3)
+    la, lb = CoverageLedger(), CoverageLedger()
+    seq_a = [a.sample(la).to_json() for _ in range(6)]
+    seq_b = [b.sample(lb).to_json() for _ in range(6)]
+    assert seq_a == seq_b
+    assert SpecSampler(seed=8).sample().to_json() != seq_a[0]
+
+
+def test_sampler_only_emits_valid_specs():
+    sampler = SpecSampler(seed=23, candidates=1)
+    for _ in range(40):
+        spec = sampler.sample()  # _draw() parses: SpecError would raise
+        assert isinstance(spec, ScenarioSpec)
+        assert spec.trainer.hosts >= 1 and spec.serve.replicas >= 1
+
+
+# --------------------------------------------------------------- coverage --
+
+def test_coverage_keys_cross_subsystem_overlap():
+    """A watcher_io poll fault overlapping a torn publish covers BOTH
+    cross pairs — the watcher-vs-quarantine race the ledger steers at."""
+    spec = parse_spec({
+        "trainer": {"hosts": 1, "fault_specs": {"0": "publish_corrupt@epoch=0"}},
+        "serve": {"replicas": 1, "fault_specs": {"0": "watcher_io@poll=2..8"}},
+    })
+    keys = coverage_keys(spec)
+    assert "publish_corruptxpublish" in keys  # own pair
+    assert "watcher_ioxwatcher" in keys
+    assert "watcher_ioxpublish" in keys      # cross pair (the race)
+    assert "publish_corruptxwatcher" in keys
+
+
+def test_ledger_persistence_and_uncovered(tmp_path):
+    path = str(tmp_path / "ledger.json")
+    led = CoverageLedger(path)
+    led.record({"nan_lossxsentinel", "watcher_ioxwatcher"})
+    led.record({"nan_lossxsentinel"})
+    led.save()
+    again = CoverageLedger.load(path)
+    assert again.pairs == {"nan_lossxsentinel": 2, "watcher_ioxwatcher": 1}
+    assert again.specs_run == 2
+    assert "nan_lossxsentinel" not in again.uncovered()
+    assert "host_lostxelastic" in again.uncovered()
+    assert len(pair_universe()) >= 100  # 13 elements x subsystems
+
+
+def test_steering_prefers_uncovered_pairs():
+    """The sampler must pick the candidate touching the most uncovered
+    pairs — uncovered pairs visibly steer the next batch."""
+    sampler = SpecSampler(seed=5, candidates=6)
+    ledger = CoverageLedger()
+    chosen = sampler.sample(ledger)
+    scored = sampler.last_candidates
+    assert len(scored) == 6
+    best = max(s for _, s in scored)
+    assert len(coverage_keys(chosen) - set(ledger.pairs)) == best
+    # saturate the ledger with the chosen spec's pairs: a re-draw of the
+    # SAME candidates must now score them lower than before
+    ledger.record(coverage_keys(chosen))
+    resampler = SpecSampler(seed=5, candidates=6)
+    rechosen = resampler.sample(ledger)
+    rescored = dict()
+    assert max(s for _, s in resampler.last_candidates) <= best
+    assert len(coverage_keys(rechosen) - set(ledger.pairs)) \
+        == max(s for _, s in resampler.last_candidates)
+
+
+def test_bounded_budget_covers_twenty_plus_pairs():
+    """Acceptance: a bounded fuzz budget demonstrates >= 20 distinct
+    (fault kind x subsystem) pairs, with the sim runner green on every
+    sampled scenario (a red here = checker/model disagreement)."""
+    ledger = CoverageLedger()
+    fuzzer = Fuzzer(sim_runner, seed=1, candidates=4, ledger=ledger)
+    result = fuzzer.run(budget=12)
+    assert not result.found, \
+        f"sim runner disagreed with checkers: {result.violations}"
+    assert ledger.specs_run == 12
+    assert ledger.distinct() >= 20
+
+
+# -------------------------------------------------------------- simulator --
+
+def _sim_spec(extra=None):
+    raw = {
+        "trainer": {"hosts": 2, "epochs": 3,
+                    "fault_specs": {"0": "ckpt_io@epoch=0"}},
+        "serve": {"replicas": 2, "fault_specs": {"0": "watcher_io@poll=2"}},
+        "timeline": [{"at": "t:10", "action": "kill_replica", "replica": 1}],
+    }
+    if extra:
+        raw.update(extra)
+    return parse_spec(raw)
+
+
+def test_simulator_deterministic_and_green():
+    spec = _sim_spec()
+    ev1 = simulate_events(spec)
+    ev2 = simulate_events(spec)
+    assert ev1 == ev2
+    assert check_invariants(ev1, spec, require_lint=True) == []
+    kinds = {e["kind"] for e in ev1}
+    for want in ("publish", "publish_torn", "quarantine", "verify_ok",
+                 "swap", "request", "lint", "watcher_error",
+                 "drain_token_acquire", "drain_token_release"):
+        assert want in kinds, f"sim never emitted {want}"
+
+
+def test_simulator_events_pass_schema():
+    from ddp_classification_pytorch_tpu.obs.events import validate_events
+
+    assert validate_events(simulate_events(_sim_spec())) == []
+
+
+def test_simulator_bug_model_adopt_unverified_is_s1_red():
+    spec = _sim_spec()
+    viols = check_invariants(simulate_events(spec, bugs=("adopt_unverified",)),
+                             spec, require_lint=True)
+    assert any(v.invariant == "S1" for v in viols)
+
+
+def test_simulator_bug_model_spike_unanswered_is_s5_red():
+    spec = parse_spec({
+        "serve": {"replicas": 1, "max_replicas": 2},
+        "timeline": [{"at": "t:10", "action": "spike_load", "rps": 12.0}],
+    })
+    viols = check_invariants(simulate_events(spec, bugs=("spike_unanswered",)),
+                             spec, require_lint=True)
+    assert any(v.invariant == "S5" for v in viols)
+    assert sim_runner(spec) == []  # the correct model answers the spike
+
+
+def test_simulator_back_to_back_wave_kills_stay_s5_green():
+    """Fuzzer-found sim-model fix: the second wave-kill acquires a
+    TTL-stale wedged token, which IS a takeover — without emitting it,
+    S5(a) sees two concurrent holders."""
+    spec = parse_spec({
+        "trainer": {"hosts": 1, "epochs": 1},
+        "serve": {"replicas": 2},
+        "timeline": [{"at": "t:0", "action": "kill_replica_during_wave"},
+                     {"at": "t:0", "action": "kill_replica_during_wave"}],
+    })
+    assert sim_runner(spec) == []
+    kinds = [e["kind"] for e in simulate_events(spec)]
+    assert kinds.count("drain_token_takeover") >= 1
+
+
+# --------------------------------------------------------------- shrinker --
+
+def _planted_runner(spec):
+    """The planted-bug fixture: red iff a trainer nan_loss and a serve
+    watcher_io co-occur anywhere in the spec."""
+    tr = ",".join(spec.trainer.fault_specs.values())
+    sv = ",".join(spec.serve.fault_specs.values())
+    if "nan_loss" in tr and "watcher_io" in sv:
+        return [Violation("PLANTED", "nan_loss x watcher_io co-occur")]
+    return []
+
+
+def test_fuzzer_finds_and_minimizes_planted_pair():
+    """Acceptance: under a fixed seed the fuzzer finds the planted-bug
+    fixture and delta-minimizes it to exactly the 2-element spec —
+    one nan_loss atom, one watcher_io atom, everything else floored."""
+    fuzzer = Fuzzer(_planted_runner, seed=0, candidates=2)
+    result = fuzzer.run(budget=30)
+    assert result.found
+    m = result.minimized
+    assert m.trainer.hosts == 1 and m.serve.replicas == 1
+    assert m.trainer.epochs == 1 and m.timeline == []
+    tr_atoms = ",".join(m.trainer.fault_specs.values()).split(",")
+    sv_atoms = ",".join(m.serve.fault_specs.values()).split(",")
+    assert len(tr_atoms) == 1 and tr_atoms[0].startswith("nan_loss@step=")
+    assert len(sv_atoms) == 1 and sv_atoms[0].startswith("watcher_io@poll=")
+    assert _planted_runner(m)  # still failing, i.e. 1-minimal cuts only
+
+
+def test_shrinker_deterministic_same_seed():
+    r1 = Fuzzer(_planted_runner, seed=0, candidates=2).run(budget=30)
+    r2 = Fuzzer(_planted_runner, seed=0, candidates=2).run(budget=30)
+    assert r1.found and r2.found
+    assert r1.minimized.to_json() == r2.minimized.to_json()
+    assert r1.specs_run == r2.specs_run
+    assert r1.shrink_runs == r2.shrink_runs
+
+
+def test_shrinker_rehomes_faults_when_dropping_topology():
+    """Shrinking hosts away must re-home the dropped host's fault onto
+    host 0, not silently delete it (the failure would vanish and the
+    cut would be rejected forever)."""
+    spec = parse_spec({
+        "trainer": {"hosts": 3, "fault_specs": {"2": "nan_loss@step=4"}},
+        "serve": {"replicas": 2, "fault_specs": {"1": "watcher_io@poll=3"}},
+    })
+    mini, runs = shrink_spec(spec, lambda s: bool(_planted_runner(s)))
+    assert mini.trainer.hosts == 1 and mini.serve.replicas == 1
+    assert "nan_loss" in mini.trainer.fault_specs.get(0, "")
+    assert "watcher_io" in mini.serve.fault_specs.get(0, "")
+    assert runs > 0
+
+
+def test_shrinker_respects_run_cap():
+    calls = []
+
+    def counting(s):
+        calls.append(1)
+        return _planted_runner(s)
+
+    spec = parse_spec({
+        "trainer": {"hosts": 3, "fault_specs": {"2": "nan_loss@step=4"}},
+        "serve": {"replicas": 2, "fault_specs": {"1": "watcher_io@poll=3"}},
+    })
+    _, runs = shrink_spec(spec, lambda s: bool(counting(s)), max_runs=5)
+    assert runs == 5 and len(calls) == 5
+
+
+def test_shrink_preserves_failure_label_not_any_red():
+    """A cut that trades the original failure for a DIFFERENT invariant's
+    red must be rejected: the minimized spec reproduces the bug it was
+    found with, not whichever red shrinks best."""
+    def runner(spec):
+        out = []
+        tr = ",".join(spec.trainer.fault_specs.values())
+        if "nan_loss" in tr and "host_lost" in tr:
+            out.append(Violation("A", "pair bug"))
+        if spec.trainer.hosts == 1:
+            out.append(Violation("B", "unrelated small-topology red"))
+        return out
+
+    spec = parse_spec({
+        "trainer": {"hosts": 2,
+                    "fault_specs": {"0": "nan_loss@step=2",
+                                    "1": "host_lost@step=4"}},
+    })
+    fuzzer = Fuzzer(runner, seed=0, candidates=1)
+    # drive the shrink directly: labels from the original failure
+    labels = {v.invariant for v in runner(spec)}
+    assert labels == {"A"}
+    mini, _ = shrink_spec(
+        spec, lambda s: bool(labels & {v.invariant for v in runner(s)}))
+    assert any(v.invariant == "A" for v in runner(mini))
+
+
+# ----------------------------------------------------------------- corpus --
+
+def _corpus_cases():
+    return sorted(os.listdir(DATA)) if os.path.isdir(DATA) else []
+
+
+def test_corpus_exists_with_green_and_red():
+    cases = _corpus_cases()
+    assert len(cases) >= 2, "regression corpus went missing"
+    expects = set()
+    for name in cases:
+        with open(os.path.join(DATA, name, "expect")) as f:
+            expects.add(f.read().strip())
+    assert expects == {"0", "1"}, \
+        "corpus must exercise both green and red replay paths"
+
+
+@pytest.mark.parametrize("name", _corpus_cases())
+def test_corpus_replay_check_only(name, capsys):
+    """Every committed minimized spec replays through the real
+    `cli.scenario --check_only` path with its recorded verdict — the
+    cheap regression the fuzzer's tentpole promises."""
+    from ddp_classification_pytorch_tpu.cli.scenario import main
+
+    d = os.path.join(DATA, name)
+    with open(os.path.join(d, "expect")) as f:
+        want = int(f.read().strip())
+    argv = ["--scenario_spec", os.path.join(d, "spec.json"),
+            "--events", os.path.join(d, "events.jsonl"),
+            "--check_only", "--out", d]
+    if want == 0:
+        main(argv)  # green replay must not raise
+    else:
+        with pytest.raises(SystemExit) as exc:
+            main(argv)
+        assert exc.value.code == want
+
+
+def test_corpus_specs_are_canonical_dumps():
+    """Committed specs must be `to_json` fixpoints so a re-minimization
+    diff is always byte-meaningful."""
+    for name in _corpus_cases():
+        with open(os.path.join(DATA, name, "spec.json")) as f:
+            text = f.read()
+        assert parse_spec(json.loads(text)).to_json() == text, name
+
+
+def test_corpus_spike_at_max_fleet_guards_s5c():
+    """The fuzzer-found S5(c) false red: a spike landing with the fleet
+    already at max_replicas. The pre-fix checker (no at-max excusal)
+    must flag this timeline; the fixed one must not."""
+    d = os.path.join(DATA, "spike-at-max-fleet")
+    with open(os.path.join(d, "spec.json")) as f:
+        spec = parse_spec(json.load(f))
+    from ddp_classification_pytorch_tpu.obs.events import read_events
+
+    events = read_events(os.path.join(d, "events.jsonl"))
+    assert check_invariants(events, spec, require_lint=True) == []
+    spikes = [e for e in events if e["kind"] == "spike_load"]
+    scale = [e["ts"] for e in events if e["kind"] == "scale_out"]
+    assert spec.serve.max_replicas > spec.serve.replicas
+    # the discriminating shape: at least one spike with NO scale_out in
+    # its window (the old checker's false red)
+    dl = spec.serve.scale_out_deadline_s
+    assert any(not any(s["ts"] <= t <= s["ts"] + dl for t in scale)
+               for s in spikes)
+
+
+# -------------------------------------------------------------- slow smoke --
+
+@pytest.mark.slow
+def test_fuzz_smoke_short_budget(tmp_path):
+    """End-to-end cli.fuzz: a short seeded sim budget runs green and
+    persists the coverage ledger; a planted red (bug-model runner)
+    writes minimized artifacts and exits 1."""
+    from ddp_classification_pytorch_tpu.cli import fuzz as cli_fuzz
+
+    out = str(tmp_path / "fuzz")
+    cli_fuzz.main(["--seed", "0", "--budget", "8", "--out", out])
+    ledger = CoverageLedger.load(os.path.join(out, "fuzz_ledger.json"))
+    assert ledger.specs_run == 8 and ledger.distinct() >= 20
+
+    # red path: a runner that simulates the adopt-unverified bug model
+    def buggy(spec):
+        return check_invariants(
+            simulate_events(spec, bugs=("adopt_unverified",)), spec,
+            require_lint=True)
+
+    fuzzer = Fuzzer(buggy, seed=0, candidates=2)
+    result = fuzzer.run(budget=10)
+    assert result.found
+    assert any(v.invariant == "S1" for v in result.violations)

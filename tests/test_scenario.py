@@ -305,6 +305,31 @@ def test_good_publishes_excludes_torn_and_quarantined():
     assert [g["epoch"] for g in goods] == [0]
 
 
+def test_good_publishes_clean_rewrite_of_condemned_path_counts():
+    """Fuzzer-found checker bug: condemnation is per WRITE, not per path
+    forever. A restart that re-publishes a previously-torn path with a
+    clean write must make that publish good again — the old path-set
+    implementation silently masked S3/S5(b) on every re-published path
+    (regression corpus: tests/data/scenarios/torn-republish-quarantine)."""
+    E = [
+        {"ts": 1.0, "kind": "publish", "source": "trainer.h0", "epoch": 1,
+         "path": "c1", "digest": "TORN", "world_size": 1},
+        {"ts": 1.1, "kind": "publish_torn", "source": "trainer.h0",
+         "epoch": 1, "path": "c1"},
+        {"ts": 2.0, "kind": "quarantine", "source": "replica0", "path": "c1",
+         "reason": "checksum mismatch"},
+        # restart rewrites the SAME path cleanly
+        {"ts": 5.0, "kind": "publish", "source": "trainer.h0", "epoch": 1,
+         "path": "c1", "digest": "CLEAN", "world_size": 1},
+    ]
+    goods = good_publishes(E)
+    assert [g["digest"] for g in goods] == ["CLEAN"]
+    # and a quarantine AFTER the rewrite condemns only the rewrite
+    E.append({"ts": 6.0, "kind": "quarantine", "source": "replica0",
+              "path": "c1", "reason": "checksum mismatch"})
+    assert good_publishes(E) == []
+
+
 def test_s1_fires_on_unverified_digest_serve():
     E = _clean_timeline()
     # replica1 answers with a digest only replica0 verified — cross-replica
@@ -501,6 +526,25 @@ def test_s5_spike_load_demands_scale_out_within_deadline():
                           _fleet_spec()) == []
 
 
+def test_s5_spike_with_fleet_already_at_max_is_excused():
+    """Fuzzer-found checker bug: a spike landing when earlier scale_outs
+    already grew the fleet to max_replicas demands nothing — the
+    autoscaler has no headroom left (regression corpus:
+    tests/data/scenarios/spike-at-max-fleet)."""
+    E = _clean_timeline()
+    E += [{"ts": 30.0, "kind": "scale_out", "source": "supervisor",
+           "replica": "replica2", "replicas": 3},
+          {"ts": 40.0, "kind": "spike_load", "source": "supervisor",
+           "rps": 10.0}]
+    assert check_s5_fleet(sorted(E, key=lambda r: r["ts"]),
+                          _fleet_spec()) == []
+    # a scale_in before the spike reopens headroom: demand is back on
+    down = E + [{"ts": 35.0, "kind": "scale_in", "source": "supervisor",
+                 "replica": "replica2", "replicas": 2}]
+    v = check_s5_fleet(sorted(down, key=lambda r: r["ts"]), _fleet_spec())
+    assert any("never answered by a" in x.message for x in v)
+
+
 def test_s3_scale_in_retirement_excuses_adoption():
     E = _clean_timeline()
     E.append({"ts": 25.0, "kind": "publish", "source": "trainer.h0",
@@ -543,6 +587,48 @@ def test_cli_scenario_check_only_red_and_green(tmp_path, capsys):
               "--events", str(ev_path), "--out", str(tmp_path)])
     assert exc.value.code == 1
     assert "VIOLATION [S1]" in capsys.readouterr().err
+
+
+def test_cli_scenario_check_only_rejects_malformed_events(tmp_path, capsys):
+    """--check_only is strict: an unknown event kind or a kind missing a
+    schema-required field is rc 2 (bad input), never a silent skip that
+    would let a truncated/corrupt events.jsonl replay 'green'."""
+    from ddp_classification_pytorch_tpu.cli.scenario import main
+
+    spec = ('{"availability": {"floor": 0.5, "window_s": 10.0, '
+            '"min_samples": 3}, "adopt_deadline_s": 20}')
+
+    def run(extra):
+        ev_path = tmp_path / "events.jsonl"
+        with open(ev_path, "w") as f:
+            for r in _clean_timeline() + extra:
+                f.write(json.dumps(r) + "\n")
+        main(["--scenario_spec", spec, "--check_only",
+              "--events", str(ev_path), "--out", str(tmp_path)])
+
+    with pytest.raises(SystemExit) as exc:  # unknown kind
+        run([{"ts": 25.0, "kind": "warp_core_breach", "source": "x"}])
+    assert exc.value.code == 2
+    assert "unknown kind" in capsys.readouterr().err
+
+    with pytest.raises(SystemExit) as exc:  # publish missing its digest
+        run([{"ts": 25.0, "kind": "publish", "source": "trainer.h0",
+              "epoch": 3, "path": "c3"}])
+    assert exc.value.code == 2
+    assert "missing required field" in capsys.readouterr().err
+
+
+def test_validate_events_unit():
+    from ddp_classification_pytorch_tpu.obs.events import (EVENT_SCHEMA,
+                                                           validate_events)
+
+    assert validate_events(_clean_timeline()) == []
+    errs = validate_events([{"ts": 1.0, "kind": "nope", "source": "x"},
+                            {"kind": "swap", "epoch": 0, "digest": "D"}])
+    assert len(errs) == 2
+    assert "unknown kind" in errs[0]
+    assert "missing required field" in errs[1] and "ts" in errs[1]
+    assert "scenario_start" in EVENT_SCHEMA and "request" in EVENT_SCHEMA
 
 
 # ------------------------------------------------------- the full drill --

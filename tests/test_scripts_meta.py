@@ -150,6 +150,27 @@ def test_scenario_script_flags_match_cli():
             f"scenario.sh default spec lost its {needle!r} fault piece"
 
 
+def test_fuzz_script_flags_match_cli():
+    """scripts/fuzz.sh must stay in sync with cli.fuzz: every --flag it
+    passes has to exist in the fuzz parser, and it must keep the seeded
+    knobs (seed/budget/runner) wired through the environment — a dropped
+    knob would quietly make nightly fuzz runs unreproducible."""
+    from ddp_classification_pytorch_tpu.cli.fuzz import build_parser
+
+    known = set()
+    for action in build_parser()._actions:
+        known.update(action.option_strings)
+    body = _script_body("fuzz.sh")
+    assert "ddp_classification_pytorch_tpu.cli.fuzz" in body
+    passed = set(re.findall(r"(?<![\w-])--[a-z_]+", body))
+    assert passed, "fuzz.sh passes no flags — launcher gutted?"
+    unknown = sorted(passed - known)
+    assert not unknown, f"fuzz.sh passes flags cli.fuzz rejects: {unknown}"
+    for needle in ("FUZZ_SEED", "FUZZ_BUDGET", "FUZZ_RUNNER",
+                   "JAX_PLATFORMS=cpu"):
+        assert needle in body, f"fuzz.sh lost its {needle!r} knob"
+
+
 def test_lint_script_flags_match_analyze_cli():
     """scripts/lint.sh is the CI gate for cli.analyze: every --flag it
     passes must exist in the analyze parser, and it must actually run the
